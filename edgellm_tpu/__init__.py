@@ -1,0 +1,14 @@
+"""TPU-native framework for split-LLM inference across distributed edge devices.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of
+``sv-goat/LLM-Inference-in-Distributed-Edge-Networks`` (mounted read-only at
+``/root/reference``): layer-split causal LMs over a ``jax.sharding.Mesh`` (each
+"edge device" = one TPU chip), boundary activation codecs as packed Pallas
+kernels crossing ``lax.ppermute``, attention/relevance token-importance scoring
+fused into the forward pass, and a sliding-window WikiText perplexity harness.
+
+Subpackages (see each subpackage's docstring; only those listed exist):
+- ``models``   — functional GPT-NeoX (Pythia) and Qwen2 cores, HF weight conversion
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,12 @@
+from .configs import ModelConfig, PYTHIA_70M, QWEN2_0_5B, QWEN2_1_5B, PRESETS, tiny_config
+from .transformer import (
+    AttnStats, forward, run_layers, embed, unembed, nll_from_logits, init_params,
+    precompute_rope,
+)
+from .hf_loader import params_from_state_dict, config_from_hf
+
+__all__ = [
+    "ModelConfig", "PYTHIA_70M", "QWEN2_0_5B", "QWEN2_1_5B", "PRESETS", "tiny_config",
+    "AttnStats", "forward", "run_layers", "embed", "unembed", "nll_from_logits",
+    "init_params", "precompute_rope", "params_from_state_dict", "config_from_hf",
+]

@@ -1,0 +1,133 @@
+"""Model architecture configs for the supported causal-LM families.
+
+The reference hardcodes two HuggingFace checkpoints — ``EleutherAI/pythia-70m``
+(``/root/reference/Experiments/Pythia-70M/pythia_model.py:25``) and
+``Qwen/Qwen2-0.5B`` (``Experiments/Qwen2-0.5B/qwen_layer_wise.py:17``).  Here the
+architecture is an explicit config so any GPT-NeoX- or Qwen2-family size runs,
+including the Qwen2-1.5B 3-hop target (BASELINE.json configs[4]) and tiny
+randomly-initialized variants used by the test suite (the environment has no
+network access to pull pretrained weights).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for one causal LM.
+
+    ``family`` selects the block wiring:
+      - ``"gpt_neox"``: parallel-residual blocks, LayerNorm (+bias), fused GELU MLP,
+        partial rotary (``rotary_pct``), biases on all linears. Pythia models.
+      - ``"qwen2"``: sequential-residual blocks, RMSNorm, SwiGLU MLP, full rotary,
+        QKV biases but bias-free o/gate/up/down projections, grouped-query attention.
+    """
+
+    family: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    intermediate_size: int
+    max_position_embeddings: int
+    norm_eps: float
+    rope_theta: float = 10000.0
+    rotary_pct: float = 1.0
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def rotary_dim(self) -> int:
+        return int(self.head_dim * self.rotary_pct)
+
+    def __post_init__(self):
+        if self.family not in ("gpt_neox", "qwen2"):
+            raise ValueError(f"unknown family: {self.family}")
+        if self.hidden_size % self.num_heads:
+            raise ValueError("num_heads must evenly divide hidden_size")
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError("num_kv_heads must evenly divide num_heads")
+
+
+# EleutherAI/pythia-70m — facts per SURVEY.md section 2.1 (6 layers, d=512, 8 heads,
+# FFN 2048 GELU, vocab 50304, LayerNorm, rotary_pct 0.25, window 2048).
+PYTHIA_70M = ModelConfig(
+    family="gpt_neox",
+    vocab_size=50304,
+    hidden_size=512,
+    num_layers=6,
+    num_heads=8,
+    num_kv_heads=8,
+    intermediate_size=2048,
+    max_position_embeddings=2048,
+    norm_eps=1e-5,
+    rope_theta=10000.0,
+    rotary_pct=0.25,
+)
+
+# Qwen/Qwen2-0.5B — 24 layers, d=896, 14 q heads / 2 kv heads (GQA), FFN 4864,
+# vocab 151936, RMSNorm eps 1e-6 (SURVEY.md section 2.1 / notebook module dumps).
+QWEN2_0_5B = ModelConfig(
+    family="qwen2",
+    vocab_size=151936,
+    hidden_size=896,
+    num_layers=24,
+    num_heads=14,
+    num_kv_heads=2,
+    intermediate_size=4864,
+    max_position_embeddings=131072,
+    norm_eps=1e-6,
+    rope_theta=1000000.0,
+    tie_word_embeddings=True,
+)
+
+# Qwen/Qwen2-1.5B — the 3-device multi-hop split target (BASELINE.json configs[4]).
+QWEN2_1_5B = ModelConfig(
+    family="qwen2",
+    vocab_size=151936,
+    hidden_size=1536,
+    num_layers=28,
+    num_heads=12,
+    num_kv_heads=2,
+    intermediate_size=8960,
+    max_position_embeddings=131072,
+    norm_eps=1e-6,
+    rope_theta=1000000.0,
+    tie_word_embeddings=True,
+)
+
+
+def tiny_config(family: str, *, num_layers: int = 4, hidden_size: int = 64,
+                num_heads: int = 4, num_kv_heads: int | None = None,
+                vocab_size: int = 256, intermediate_size: int | None = None) -> ModelConfig:
+    """Small random-init config for tests (no pretrained weights in this environment)."""
+    if num_kv_heads is None:
+        num_kv_heads = 2 if family == "qwen2" else num_heads
+    if intermediate_size is None:
+        intermediate_size = hidden_size * 4
+    return ModelConfig(
+        family=family,
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        intermediate_size=intermediate_size,
+        max_position_embeddings=512,
+        norm_eps=1e-5 if family == "gpt_neox" else 1e-6,
+        rope_theta=10000.0 if family == "gpt_neox" else 1000000.0,
+        rotary_pct=0.25 if family == "gpt_neox" else 1.0,
+        tie_word_embeddings=family == "qwen2",
+    )
+
+
+PRESETS = {
+    "pythia-70m": PYTHIA_70M,
+    "qwen2-0.5b": QWEN2_0_5B,
+    "qwen2-1.5b": QWEN2_1_5B,
+}

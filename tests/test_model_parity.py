@@ -1,0 +1,106 @@
+"""Numerical parity of the JAX cores vs HuggingFace torch implementations.
+
+The environment is offline (no pretrained checkpoints), so parity is checked
+against *randomly initialized* ``transformers`` models built from small configs —
+this validates every architectural detail (fused-QKV head interleaving, partial
+rotary, parallel residual, GQA, SwiGLU, norm placement) without network access.
+The reference's only correctness check was that its manual layer loop matched the
+stock model's perplexity (``qwen_layer_wise.py:78-104``); this is the same idea,
+made exact at the logits level.
+"""
+import numpy as np
+import pytest
+import torch
+
+torch.manual_seed(0)
+
+from transformers import GPTNeoXConfig, GPTNeoXForCausalLM, Qwen2Config, Qwen2ForCausalLM
+
+import jax.numpy as jnp
+
+from edgellm_tpu.models import (
+    config_from_hf, params_from_state_dict, forward, nll_from_logits,
+)
+
+
+def _build_neox():
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        intermediate_size=256, rotary_pct=0.25, max_position_embeddings=128,
+        hidden_act="gelu", layer_norm_eps=1e-5, use_parallel_residual=True,
+        attn_implementation="eager",
+    )
+    model = GPTNeoXForCausalLM(hf_cfg).eval()
+    return hf_cfg, model
+
+
+def _build_qwen2():
+    hf_cfg = Qwen2Config(
+        vocab_size=256, hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=128, max_position_embeddings=128,
+        rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    return hf_cfg, model
+
+
+@pytest.fixture(scope="module", params=["gpt_neox", "qwen2"])
+def family_setup(request):
+    builder = _build_neox if request.param == "gpt_neox" else _build_qwen2
+    hf_cfg, model = builder()
+    cfg = config_from_hf(hf_cfg)
+    params = params_from_state_dict(cfg, model.state_dict())
+    ids = np.random.default_rng(1).integers(0, hf_cfg.vocab_size, size=(1, 48))
+    with torch.no_grad():
+        out = model(torch.tensor(ids), output_attentions=True)
+    return cfg, params, ids, out
+
+
+def test_logits_parity(family_setup):
+    cfg, params, ids, hf_out = family_setup
+    logits, _ = forward(cfg, params, jnp.asarray(ids))
+    ref = hf_out.logits.numpy()
+    np.testing.assert_allclose(np.asarray(logits), ref, atol=2e-4, rtol=2e-3)
+
+
+def test_attention_stats_parity(family_setup):
+    cfg, params, ids, hf_out = family_setup
+    _, aux = forward(cfg, params, jnp.asarray(ids), capture_stats=True)
+    stats = aux["stats"]
+    for layer, attn in enumerate(hf_out.attentions):
+        a = attn.numpy()  # (B, H, S, S)
+        np.testing.assert_allclose(
+            np.asarray(stats.col_mean[layer]), a.mean(axis=2), atol=1e-5, rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(stats.last_row[layer]), a[:, :, -1, :], atol=1e-5, rtol=1e-4)
+
+
+def test_nll_matches_torch_cross_entropy(family_setup):
+    cfg, params, ids, hf_out = family_setup
+    logits, _ = forward(cfg, params, jnp.asarray(ids))
+    targets = np.array(ids)
+    targets[:, :5] = -100  # mimic the harness's overlap masking
+    nll = nll_from_logits(logits, jnp.asarray(targets))
+    t_logits = hf_out.logits[:, :-1, :].reshape(-1, hf_out.logits.shape[-1])
+    t_targets = torch.tensor(targets[:, 1:]).reshape(-1)
+    ref = torch.nn.functional.cross_entropy(t_logits, t_targets, ignore_index=-100)
+    np.testing.assert_allclose(float(nll), float(ref), atol=1e-5, rtol=1e-5)
+
+
+def test_boundary_fn_interception(family_setup):
+    """boundary_fn edits the hidden state after exactly the targeted layer."""
+    cfg, params, ids, _ = family_setup
+
+    def zero_at_layer1(idx, h):
+        return jnp.where(idx == 1, jnp.zeros_like(h), h)
+
+    base, _ = forward(cfg, params, jnp.asarray(ids))
+    edited, _ = forward(cfg, params, jnp.asarray(ids), boundary_fn=zero_at_layer1)
+    assert not np.allclose(np.asarray(base), np.asarray(edited))
+
+    def noop(idx, h):
+        return jnp.where(idx == 99, jnp.zeros_like(h), h)
+
+    same, _ = forward(cfg, params, jnp.asarray(ids), boundary_fn=noop)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(same), atol=1e-6)
