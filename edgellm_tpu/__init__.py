@@ -7,8 +7,9 @@ A ground-up JAX/XLA/Pallas re-design with the capabilities of
 kernels crossing ``lax.ppermute``, attention/relevance token-importance scoring
 fused into the forward pass, and a sliding-window WikiText perplexity harness.
 
-Subpackages (see each subpackage's docstring; only those listed exist):
+Subpackages (see each subpackage's docstring):
 - ``models``   — functional GPT-NeoX (Pythia) and Qwen2 cores, HF weight conversion
+- ``codecs``   — boundary activation quantizers (simulate + packed)
 """
 
 __version__ = "0.1.0"

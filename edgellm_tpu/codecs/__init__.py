@@ -1,0 +1,26 @@
+"""Boundary activation codecs.
+
+``simulate`` holds the pure quantize->dequantize ("fake quant") codecs matching the
+reference's simulated boundary compression; ``packing`` produces real packed byte
+buffers (the thing that actually crosses the device boundary in the split runtime)
+plus exact byte accounting.
+"""
+from .simulate import (
+    token_select_mask,
+    top_rho_mask,
+    int4_token_select,
+    simulate_symmetric,
+    per_token_affine_int8,
+    channel_wise_quant,
+    CHANNEL_METHODS,
+)
+
+__all__ = [
+    "token_select_mask",
+    "top_rho_mask",
+    "int4_token_select",
+    "simulate_symmetric",
+    "per_token_affine_int8",
+    "channel_wise_quant",
+    "CHANNEL_METHODS",
+]

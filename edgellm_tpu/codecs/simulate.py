@@ -1,0 +1,144 @@
+"""Simulated (quantize->dequantize in fp) boundary codecs, jit-safe.
+
+These reproduce the reference's boundary compression semantics exactly, but as pure
+vectorized functions with static shapes instead of in-place fancy-indexed edits:
+
+- token-selective symmetric int4 over the ``ratio`` least-important tokens, with one
+  *global* max-abs scale over the whole selected slice
+  (``/root/reference/Experiments/Qwen2-0.5B/qwen_layer_wise.py:54-73``,
+  ``Experiments/Pythia-70M/pythia_model.py:167-191``);
+- per-token affine int8 (``pythia_model.py:57-68`` — implemented with correct
+  scale/zero-point math; the committed reference passes ``scale = max-min`` and a
+  tensor zero-point into ``torch.quantize_per_tensor`` and crashes, see SURVEY.md
+  section 2.1);
+- per-channel symmetric 8/4-bit and ternary mean/max codecs
+  (``qwen_layer_wise.py:106-152``), vectorized over the channel axis instead of a
+  Python loop over 896 channels;
+- top-rho importance-mass token selection (``pythia_model.py:95-109``) as a
+  cumulative-sum over the sorted distribution instead of a greedy Python loop.
+
+Dynamic token selection under jit: ``hidden[:, idx, :] = q(...)`` becomes a boolean
+mask + ``jnp.where`` (static shapes; the quantized values are computed everywhere and
+selected where the mask is set — the masked lanes are dead code XLA fuses away).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+CHANNEL_METHODS = ("channel_8", "channel_4", "channel_1_mean", "channel_1_max")
+
+
+def token_select_mask(importance: jnp.ndarray, ratio, seq_len: int) -> jnp.ndarray:
+    """Boolean mask (S,) marking the ``int(ratio * seq_len)`` least-important tokens.
+
+    Matches ``argsort(importance, descending=False)[:int(ratio*S)]``
+    (``qwen_layer_wise.py:57``): ascending stable argsort, take the first k.
+    jit-safe version: rank every position by importance (stable, so ties break by
+    position exactly like torch's stable sort) and mark ranks < k.
+    """
+    order = jnp.argsort(importance)  # ascending, stable
+    rank = jnp.argsort(order)  # rank[i] = position of token i in ascending order
+    k = jnp.floor(ratio * seq_len).astype(jnp.int32)
+    return rank < k
+
+
+def top_rho_mask(distribution: jnp.ndarray, threshold) -> jnp.ndarray:
+    """Mask of tokens to QUANTIZE under the "upto ratio" (top-rho) scheme.
+
+    The reference greedily walks the importance distribution in descending order,
+    keeping tokens until the kept mass reaches ``threshold`` (= 1 - 0.1*ratio), and
+    quantizes every token after that point (``pythia_model.py:95-109``). A token is
+    kept iff the exclusive prefix-sum of the descending-sorted distribution at its
+    position is still below the threshold; everything else is quantized.
+    """
+    order = jnp.argsort(-distribution)  # descending, stable (ties by position)
+    sorted_vals = distribution[order]
+    excl_cumsum = jnp.cumsum(sorted_vals) - sorted_vals
+    quantize_sorted = excl_cumsum >= threshold
+    # scatter back to original token positions
+    mask = jnp.zeros_like(quantize_sorted).at[order].set(quantize_sorted)
+    return mask
+
+
+def _masked_symmetric(hidden: jnp.ndarray, mask: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Symmetric fake-quant of masked token positions with one global scale.
+
+    ``mask``: (S,) over the token axis of ``hidden`` (B, S, D). The scale is the max
+    |value| over the *selected slice only* — all batch rows, all channels — exactly
+    the reference's ``max(|hidden[:, least_important, :]|)`` (``qwen_layer_wise.py:60``).
+    """
+    qmax = 2 ** (bits - 1) - 1
+    qmin = -(2 ** (bits - 1))
+    m = mask[None, :, None]
+    max_val = jnp.max(jnp.where(m, jnp.abs(hidden), 0.0))
+    max_val = jnp.where(max_val > 0, max_val, 1.0)  # mask empty / all-zero: no-op below
+    scaled = jnp.clip(hidden / max_val * qmax, qmin, qmax)
+    deq = jnp.round(scaled) / qmax * max_val
+    return jnp.where(m, deq, hidden)
+
+
+def int4_token_select(hidden: jnp.ndarray, importance: jnp.ndarray, ratio) -> jnp.ndarray:
+    """The reference's headline codec: symmetric int4 on the least-important tokens."""
+    mask = token_select_mask(importance, ratio, hidden.shape[1])
+    return _masked_symmetric(hidden, mask, bits=4)
+
+
+def simulate_symmetric(hidden: jnp.ndarray, mask: jnp.ndarray, bits: int = 4) -> jnp.ndarray:
+    """Generic masked symmetric fake-quant (int2..int8) with global max-abs scale."""
+    return _masked_symmetric(hidden, mask, bits)
+
+
+def per_token_affine_int8(hidden: jnp.ndarray, mask: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Per-token affine int8: each token's D-vector gets its own (scale, zero_point).
+
+    This is the *documented intent* of ``Pythia70Model.simulate_quantization``
+    (``pythia_model.py:57-68``) with correct affine math: scale = (max-min)/255,
+    zero_point chosen so min maps to -128; q = clamp(round(x/scale)+zp, -128, 127).
+    The committed reference version crashes (SURVEY.md section 2.1).
+    """
+    mn = jnp.min(hidden, axis=-1, keepdims=True)
+    mx = jnp.max(hidden, axis=-1, keepdims=True)
+    scale = (mx - mn) / 255.0
+    safe_scale = jnp.where(scale > 0, scale, 1.0)
+    zp = jnp.round(-128.0 - mn / safe_scale)
+    q = jnp.clip(jnp.round(hidden / safe_scale) + zp, -128, 127)
+    # constant tokens (mx == mn) are exactly representable: pass through unchanged
+    deq = jnp.where(scale > 0, (q - zp) * safe_scale, hidden)
+    if mask is None:
+        return deq
+    return jnp.where(mask[None, :, None], deq, hidden)
+
+
+def channel_wise_quant(hidden: jnp.ndarray, method: str) -> jnp.ndarray:
+    """Per-channel boundary codecs (``qwen_layer_wise.py:106-152``), vectorized.
+
+    The reference loops Python-level over all D channels; here the channel axis is
+    just the reduction layout — one fused XLA op. Scales are computed per channel
+    over the (batch, seq) slice, exactly like the reference's
+    ``hidden_states[:, :, c]`` reductions:
+
+    - ``channel_8`` / ``channel_4``: symmetric max-abs, round to +/-127 / +/-7 (no
+      clamp needed: |x| <= max by construction);
+    - ``channel_1_mean``: BitNet-style: scale = *signed* mean + 1e-8, round then
+      clamp to {-1, 0, 1} (``qwen_layer_wise.py:135-142`` — the signed mean is
+      faithfully kept, it is the reference's behavior);
+    - ``channel_1_max``: same with max-abs scale.
+    """
+    if method not in CHANNEL_METHODS:
+        raise ValueError(f"unknown channel method {method!r}; options: {CHANNEL_METHODS}")
+    if method in ("channel_8", "channel_4"):
+        max_levels = 127.0 if method == "channel_8" else 7.0
+        cmax = jnp.max(jnp.abs(hidden), axis=(0, 1), keepdims=True)
+        safe = jnp.where(cmax > 0, cmax, 1.0)
+        q = jnp.round(hidden / safe * max_levels)
+        return jnp.where(cmax > 0, q * safe / max_levels, hidden)
+    if method == "channel_1_mean":
+        scale = jnp.mean(hidden, axis=(0, 1), keepdims=True) + 1e-8
+        q = jnp.clip(jnp.round(hidden / scale), -1, 1)
+        return q * scale
+    # channel_1_max
+    cmax = jnp.max(jnp.abs(hidden), axis=(0, 1), keepdims=True)
+    safe = jnp.where(cmax > 0, cmax, 1.0)
+    q = jnp.clip(jnp.round(hidden / safe), -1, 1)
+    return jnp.where(cmax > 0, q * safe, hidden)

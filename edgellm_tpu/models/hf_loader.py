@@ -127,6 +127,8 @@ def config_from_hf(hf_config) -> ModelConfig:
             raise ValueError(f"gpt_neox hidden_act={hf_config.hidden_act!r} not supported (gelu only)")
         if not getattr(hf_config, "attention_bias", True):
             raise ValueError("gpt_neox with attention_bias=False is not supported")
+        if getattr(hf_config, "rope_scaling", None):
+            raise ValueError("gpt_neox rope_scaling is not supported (vanilla RoPE only)")
         return ModelConfig(
             family="gpt_neox",
             vocab_size=hf_config.vocab_size,
@@ -142,6 +144,10 @@ def config_from_hf(hf_config) -> ModelConfig:
             tie_word_embeddings=hf_config.tie_word_embeddings,
         )
     if mt == "qwen2":
+        if getattr(hf_config, "rope_scaling", None):
+            raise ValueError("qwen2 rope_scaling is not supported (vanilla RoPE only)")
+        if getattr(hf_config, "use_sliding_window", False):
+            raise ValueError("qwen2 sliding-window attention is not supported")
         return ModelConfig(
             family="qwen2",
             vocab_size=hf_config.vocab_size,

@@ -1,0 +1,146 @@
+"""Codec unit tests: the jit-safe vectorized codecs must match naive oracle
+implementations that follow the reference's algorithms literally (greedy loops,
+per-channel Python loops, fancy-indexed in-place edits) — see SURVEY.md section 2.1
+and ``/root/reference/Experiments/Qwen2-0.5B/qwen_layer_wise.py:54-152``.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.codecs import (
+    token_select_mask,
+    top_rho_mask,
+    int4_token_select,
+    per_token_affine_int8,
+    channel_wise_quant,
+    CHANNEL_METHODS,
+)
+
+
+def _oracle_token_select_int4(hidden: np.ndarray, importance: np.ndarray, ratio: float):
+    """Literal re-enactment of qwen_layer_wise.py:54-70 in numpy."""
+    h = hidden.copy()
+    s = h.shape[1]
+    idx = np.argsort(importance, kind="stable")[: int(ratio * s)]
+    if len(idx) == 0:
+        return h
+    sel = h[:, idx, :]
+    max_val = np.max(np.abs(sel))
+    scaled = np.clip(sel / max_val * 7.0, -8.0, 7.0)
+    h[:, idx, :] = np.round(scaled) / 7.0 * max_val
+    return h
+
+
+def _oracle_top_rho(distribution: np.ndarray, threshold: float):
+    """Literal greedy loop of pythia_model.py:95-109; returns quantized-token set."""
+    pairs = sorted(enumerate(distribution), key=lambda x: x[1], reverse=True)
+    total, n_kept = 0.0, 0
+    for _, value in pairs:
+        if total >= threshold:
+            break
+        total += value
+        n_kept += 1
+    return {i for i, _ in pairs[n_kept:]}
+
+
+def _oracle_channel_wise(hidden: np.ndarray, method: str):
+    """Literal per-channel loop of qwen_layer_wise.py:122-152."""
+    h = hidden.copy()
+    for c in range(h.shape[2]):
+        ch = h[:, :, c]
+        if method in ("channel_8", "channel_4"):
+            levels = 127.0 if method == "channel_8" else 7.0
+            cmax = np.max(np.abs(ch))
+            h[:, :, c] = np.round(ch / cmax * levels) * cmax / levels
+        elif method == "channel_1_mean":
+            mean = np.mean(ch) + 1e-8
+            h[:, :, c] = np.clip(np.round(ch / mean), -1, 1) * mean
+        else:
+            cmax = np.max(np.abs(ch))
+            h[:, :, c] = np.clip(np.round(ch / cmax), -1, 1) * cmax
+    return h
+
+
+@pytest.fixture
+def hidden(rng):
+    return rng.normal(size=(2, 24, 16)).astype(np.float32)
+
+
+@pytest.mark.parametrize("ratio", [0.0, 0.1, 0.25, 0.5, 0.75, 1.0])
+def test_int4_token_select_matches_reference_semantics(hidden, rng, ratio):
+    importance = rng.random(24).astype(np.float32)
+    got = np.asarray(int4_token_select(jnp.asarray(hidden), jnp.asarray(importance), ratio))
+    want = _oracle_token_select_int4(hidden, importance, ratio)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_int4_values_live_on_16_level_grid(hidden, rng):
+    importance = rng.random(24).astype(np.float32)
+    out = np.asarray(int4_token_select(jnp.asarray(hidden), jnp.asarray(importance), 1.0))
+    max_val = np.max(np.abs(hidden))
+    codes = out / max_val * 7.0
+    np.testing.assert_allclose(codes, np.round(codes), atol=1e-5)
+    assert codes.min() >= -8.0 - 1e-5 and codes.max() <= 7.0 + 1e-5
+
+
+def test_token_select_mask_ties_break_like_stable_argsort():
+    imp = jnp.asarray([0.5, 0.2, 0.2, 0.9, 0.2])
+    mask = np.asarray(token_select_mask(imp, 0.6, 5))  # k = 3 least important
+    # stable ascending: positions 1, 2, 4 (the tied 0.2s in original order)
+    np.testing.assert_array_equal(mask, [False, True, True, False, True])
+
+
+@pytest.mark.parametrize("ratio", [0, 1, 3, 5, 8, 10])
+def test_top_rho_mask_matches_greedy_loop(rng, ratio):
+    dist = rng.random(32).astype(np.float64)
+    dist /= dist.sum()
+    threshold = 1.0 - 0.1 * ratio
+    mask = np.asarray(top_rho_mask(jnp.asarray(dist), threshold))
+    want = _oracle_top_rho(dist, threshold)
+    assert {i for i in range(32) if mask[i]} == want
+
+
+@pytest.mark.parametrize("method", CHANNEL_METHODS)
+def test_channel_wise_matches_reference_loop(hidden, method):
+    got = np.asarray(channel_wise_quant(jnp.asarray(hidden), method))
+    want = _oracle_channel_wise(hidden, method)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_per_token_affine_int8_roundtrip(hidden):
+    out = np.asarray(per_token_affine_int8(jnp.asarray(hidden)))
+    # error bounded by half a quantization step per token
+    step = (hidden.max(-1) - hidden.min(-1)) / 255.0
+    assert np.all(np.abs(out - hidden) <= step[..., None] * 0.5 + 1e-6)
+
+
+def test_per_token_affine_int8_respects_mask(hidden):
+    mask = np.zeros(24, bool)
+    mask[3:7] = True
+    out = np.asarray(per_token_affine_int8(jnp.asarray(hidden), jnp.asarray(mask)))
+    np.testing.assert_array_equal(out[:, ~mask, :], hidden[:, ~mask, :])
+    assert not np.allclose(out[:, mask, :], hidden[:, mask, :])
+
+
+def test_codecs_are_jittable(hidden, rng):
+    importance = jnp.asarray(rng.random(24).astype(np.float32))
+    h = jnp.asarray(hidden)
+    jit_sel = jax.jit(int4_token_select, static_argnames=())
+    np.testing.assert_allclose(
+        np.asarray(jit_sel(h, importance, 0.5)),
+        np.asarray(int4_token_select(h, importance, 0.5)), atol=1e-6)
+    jit_ch = jax.jit(channel_wise_quant, static_argnums=(1,))
+    np.testing.assert_allclose(
+        np.asarray(jit_ch(h, "channel_4")),
+        np.asarray(channel_wise_quant(h, "channel_4")), atol=1e-6)
+
+
+def test_degenerate_inputs_do_not_nan():
+    h = jnp.zeros((1, 8, 4))
+    imp = jnp.arange(8.0)
+    assert np.isfinite(np.asarray(int4_token_select(h, imp, 0.5))).all()
+    for m in CHANNEL_METHODS:
+        assert np.isfinite(np.asarray(channel_wise_quant(h, m))).all()
+    assert np.isfinite(np.asarray(per_token_affine_int8(h))).all()
