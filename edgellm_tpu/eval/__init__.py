@@ -1,0 +1,26 @@
+"""WikiText sliding-window perplexity harness and sweep drivers.
+
+Reproduces the reference's evaluation semantics exactly (they define the metric):
+corpus joined with ``"\\n\\n"``, fixed window advanced by ``stride``, overlap masked
+to ``-100``, token-weighted NLL accumulation, ``PPL = exp(total_nll / n_tokens)``
+(``/root/reference/Experiments/Qwen2-0.5B/main.py:151-207``) — while restructuring
+the compute for TPU: one stats forward per chunk with boundary activations cached at
+every split layer, and the (ratio) axis vmapped so each method x layer combination
+costs one *suffix* run instead of a full forward.
+"""
+from .windowing import Chunk, sliding_windows
+from .harness import (
+    SweepResult,
+    run_token_sweep,
+    run_initial_sweep,
+    run_channel_sweep,
+)
+
+__all__ = [
+    "Chunk",
+    "sliding_windows",
+    "SweepResult",
+    "run_token_sweep",
+    "run_initial_sweep",
+    "run_channel_sweep",
+]

@@ -1,0 +1,406 @@
+"""Sweep drivers: (method x split-layer x ratio) perplexity sweeps, restructured for TPU.
+
+The reference recomputes a **full** model forward for every combination — with its
+committed Qwen params that is 1 eager + 20 quantized forwards per 32-token stride,
+~16 s/chunk on the Colab GPU (``qwen2-0.5B_experiment.ipynb`` cell 12;
+``Qwen2-0.5B/main.py:170-178``). Here each chunk runs ONE forward that captures
+attention statistics *and* caches the boundary activation at every split layer of
+interest; each (method, layer, ratio) combination then costs only a quantize + the
+layer suffix [l+1, L), with the ratio axis vmapped into a single batched suffix run.
+Identical math (the suffix resumes from the exact pre-quantization hidden state the
+reference recomputes), a fraction of the FLOPs.
+
+Accumulation semantics are preserved per experiment:
+- token-weighted: ``total += nll * num_loss_tokens; PPL = exp(total / n_tokens)``
+  (``Qwen2-0.5B/main.py:166-207``, ``last_row_exp.py:100-143``, ``channel_wise.py:42-49``)
+- unweighted mean-of-chunk-means for the Pythia "initial" experiment
+  (``initial_exp.py:123-133``)
+
+Checkpoint/resume: the reference pickles partial sums every 1000 chunks but cannot
+resume (``main.py:184-192``); here the JSON checkpoint stores the next chunk index
+and restart is exact.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import os
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models import run_layers, unembed, nll_from_logits
+from ..models.transformer import run_layers_from_ids
+from ..models.configs import ModelConfig
+from ..codecs import (
+    int4_token_select,
+    token_select_mask,
+    top_rho_mask,
+    per_token_affine_int8,
+    channel_wise_quant,
+)
+from ..importance import importance_per_layer, aggregate_upto, maximum_aggregation, regular_importance
+from .windowing import sliding_windows
+
+TOKEN_CODECS = ("int4_token_select", "affine_int8_rank", "affine_int8_top_rho")
+
+
+def _apply_token_codec(codec: str, hidden, importance, ratio):
+    """Quantize ``hidden`` (B, S, D) at the boundary under one token codec.
+
+    ``ratio`` is always a *fraction* here; "initial"-style integer ratios are
+    normalized by the driver (the reference multiplies by 0.1 at use sites:
+    ``pythia_model.py:95,142``).
+    """
+    seq_len = hidden.shape[1]
+    if codec == "int4_token_select":
+        return int4_token_select(hidden, importance, ratio)
+    if codec == "affine_int8_rank":
+        mask = token_select_mask(importance, ratio, seq_len)
+        return per_token_affine_int8(hidden, mask)
+    if codec == "affine_int8_top_rho":
+        mask = top_rho_mask(importance, 1.0 - ratio)
+        return per_token_affine_int8(hidden, mask)
+    raise ValueError(f"unknown token codec {codec!r}; options: {TOKEN_CODECS}")
+
+
+@functools.lru_cache(maxsize=None)
+def _stats_forward(cfg: ModelConfig):
+    """Jitted prefix pass: ids -> (attention stats, all boundary hiddens).
+
+    No logits/NLL here: every (method, layer, ratio) combination -- including
+    ratio 0, the fp baseline -- gets its NLL from the suffix path, so computing
+    the full-vocab unembed in this pass would be pure waste.
+    """
+
+    @jax.jit
+    def fn(params, ids):
+        _, aux = run_layers_from_ids(cfg, params, ids, capture_stats=True)
+        return aux["stats"], aux["hiddens"]
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _plain_forward(cfg: ModelConfig):
+    """Jitted prefix pass without attention stats (channel sweep)."""
+
+    @jax.jit
+    def fn(params, ids):
+        _, aux = run_layers_from_ids(cfg, params, ids, capture_stats=False)
+        return aux["hiddens"]
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _suffix_sweep(cfg: ModelConfig, layer: int, codec: str):
+    """Jitted: boundary hidden at ``layer`` -> per-ratio NLLs via one vmapped suffix.
+
+    This is the reference's batched-over-ratios intent (``pythia_model.py:36-54``,
+    one batch row per ratio) done as a vmap — the batched suffix runs as one
+    executable with the ratio axis as a leading batch dimension.
+    """
+
+    @jax.jit
+    def fn(params, boundary_hidden, targets, importance, ratios):
+        def one(ratio):
+            h = _apply_token_codec(codec, boundary_hidden, importance, ratio)
+            out, _ = run_layers(cfg, params, h, start=layer + 1)
+            return nll_from_logits(unembed(cfg, params, out), targets)
+
+        return jax.vmap(one)(ratios)
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _suffix_channel(cfg: ModelConfig, layer: int, method: str):
+    """Jitted: boundary hidden -> NLL under one per-channel codec."""
+
+    @jax.jit
+    def fn(params, boundary_hidden, targets):
+        h = channel_wise_quant(boundary_hidden, method)
+        out, _ = run_layers(cfg, params, h, start=layer + 1)
+        return nll_from_logits(unembed(cfg, params, out), targets)
+
+    return fn
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Accumulated sweep state. ``total_nll`` indexed [method][layer][ratio] (token
+    sweeps), [method][layer] (channel sweep), or [layer][ratio] (initial)."""
+
+    axes: dict
+    total_nll: np.ndarray
+    n_tokens: float
+    chunks: int
+    weighting: str  # "token_weighted" | "mean_of_means"
+    wall_s: float = 0.0
+
+    def ppl(self) -> np.ndarray:
+        denom = self.n_tokens if self.weighting == "token_weighted" else max(self.chunks, 1)
+        return np.exp(self.total_nll / max(denom, 1e-9))
+
+    def to_json(self) -> dict:
+        return {
+            "axes": self.axes,
+            "total_nll": self.total_nll.tolist(),
+            "n_tokens": self.n_tokens,
+            "chunks": self.chunks,
+            "weighting": self.weighting,
+            "wall_s": self.wall_s,
+            "ppl": self.ppl().tolist(),
+        }
+
+
+def _load_checkpoint(path: Optional[str], axes: dict) -> Optional[dict]:
+    """Load a resume checkpoint only if it was written by the SAME sweep
+    configuration — a stale checkpoint from a different axes layout must not be
+    silently resumed (its shape may still match)."""
+    if path and os.path.exists(path):
+        with open(path) as f:
+            state = json.load(f)
+        if state.get("axes") == json.loads(json.dumps(axes)):
+            return state
+        raise ValueError(
+            f"checkpoint {path} was written by a different sweep configuration "
+            f"({state.get('axes')} != {axes}); delete it or use a fresh output dir")
+    return None
+
+
+def _save_checkpoint(path: Optional[str], result: SweepResult, next_chunk: int):
+    if not path:
+        return
+    state = {"next_chunk": next_chunk, "axes": result.axes,
+             "total_nll": result.total_nll.tolist(),
+             "n_tokens": result.n_tokens, "chunks": result.chunks}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(state, f)
+    os.replace(tmp, path)
+
+
+def _emit(metrics_path: Optional[str], record: dict):
+    if not metrics_path:
+        return
+    with open(metrics_path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def run_token_sweep(
+    cfg: ModelConfig,
+    params,
+    token_ids: np.ndarray,
+    *,
+    methods: Sequence[str],
+    layers_of_interest: Sequence[int],
+    ratios: Sequence[float],
+    max_length: int,
+    stride: int,
+    head_weights: Optional[np.ndarray] = None,
+    codec: str = "int4_token_select",
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1000,
+    metrics_path: Optional[str] = None,
+    max_chunks: Optional[int] = None,
+    progress: Optional[Callable[[int], None]] = None,
+) -> SweepResult:
+    """The main (method x split-layer x ratio) token-selective sweep.
+
+    Reproduces ``Qwen2-0.5B/main.py:136-207`` and ``last_row_exp.py:72-143``:
+    token-weighted NLL, int4 token-selective codec at the split layer, importance
+    from the four attention methods. ``ratios`` are fractions (0..1).
+    """
+    bad = [l for l in layers_of_interest if not 0 <= int(l) < cfg.num_layers]
+    if bad:
+        raise ValueError(f"layers_of_interest {bad} out of range for a "
+                         f"{cfg.num_layers}-layer model")
+    shape = (len(methods), len(layers_of_interest), len(ratios))
+    result = SweepResult(
+        axes={"methods": list(methods), "layers_of_interest": list(layers_of_interest),
+              "ratios": list(ratios)},
+        total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="token_weighted")
+    start_chunk = 0
+    if (state := _load_checkpoint(checkpoint_path, result.axes)) is not None:
+        result.total_nll = np.asarray(state["total_nll"])
+        result.n_tokens, result.chunks = state["n_tokens"], state["chunks"]
+        start_chunk = state["next_chunk"]
+
+    hw = None if head_weights is None else jnp.asarray(head_weights)
+    ratios_arr = jnp.asarray(np.asarray(ratios, np.float32))
+    stats_fn = _stats_forward(cfg)
+    t0 = time.monotonic()
+    next_chunk = start_chunk
+
+    for chunk in sliding_windows(token_ids, max_length, stride):
+        if chunk.index < start_chunk:
+            continue
+        if max_chunks is not None and result.chunks >= max_chunks:
+            break
+        ids, targets = jnp.asarray(chunk.input_ids), jnp.asarray(chunk.target_ids)
+        stats, hiddens = stats_fn(params, ids)
+        next_chunk = chunk.index + 1
+        for m, method in enumerate(methods):
+            imp = importance_per_layer(stats, method, hw)  # (L, B, S)
+            for l, layer in enumerate(layers_of_interest):
+                nlls = _suffix_sweep(cfg, int(layer), codec)(
+                    params, hiddens[layer], targets, imp[layer, 0], ratios_arr)
+                result.total_nll[m, l] += np.asarray(nlls) * chunk.num_loss_tokens
+        result.n_tokens += chunk.num_loss_tokens
+        result.chunks += 1
+        if progress:
+            progress(chunk.index)
+        if result.chunks % checkpoint_every == 0:
+            _save_checkpoint(checkpoint_path, result, chunk.index + 1)
+            _emit(metrics_path, {"chunk": chunk.index, "n_tokens": result.n_tokens,
+                                 "ppl": result.ppl().tolist()})
+    result.wall_s = time.monotonic() - t0
+    _save_checkpoint(checkpoint_path, result, next_chunk)
+    _emit(metrics_path, {"final": True, "chunks": result.chunks,
+                         "n_tokens": result.n_tokens, "ppl": result.ppl().tolist(),
+                         "wall_s": result.wall_s})
+    return result
+
+
+def run_initial_sweep(
+    cfg: ModelConfig,
+    params,
+    token_ids: np.ndarray,
+    *,
+    layers_of_interest: Sequence,
+    ratios: Sequence[float],
+    max_length: int,
+    stride: int,
+    quant_layer: int = 2,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1000,
+    metrics_path: Optional[str] = None,
+    max_chunks: Optional[int] = None,
+) -> SweepResult:
+    """The Pythia "initial" experiment (``initial_exp.py:74-137``).
+
+    ``layers_of_interest`` may mix layer ints with the magic strings
+    ``'aggregate upto 2'``, ``'maximum aggregation'``, ``'upto ratio'`` — each
+    selects how the token ordering/distribution is built (``initial_exp.py:27-72``);
+    quantization always happens at ``quant_layer`` (=2 in the reference dispatch,
+    ``initial_exp.py:117-122``) with the per-token affine int8 codec. ``ratios``
+    follow the reference's 0..10 integer convention (fraction = 0.1 * ratio,
+    ``pythia_model.py:95,142``). Accumulation is the unweighted mean of per-chunk
+    NLL means (``initial_exp.py:123-133``).
+    """
+    magic = {"aggregate upto 2", "maximum aggregation", "upto ratio"}
+    bad = [l for l in layers_of_interest
+           if l not in magic and not 0 <= int(l) < cfg.num_layers]
+    if bad or not 0 <= quant_layer < cfg.num_layers:
+        raise ValueError(f"layer specs {bad or [quant_layer]} out of range for a "
+                         f"{cfg.num_layers}-layer model")
+    shape = (len(layers_of_interest), len(ratios))
+    result = SweepResult(
+        axes={"layers_of_interest": [str(l) for l in layers_of_interest],
+              "ratios": list(ratios)},
+        total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="mean_of_means")
+    start_chunk = 0
+    if (state := _load_checkpoint(checkpoint_path, result.axes)) is not None:
+        result.total_nll = np.asarray(state["total_nll"])
+        result.n_tokens, result.chunks = state["n_tokens"], state["chunks"]
+        start_chunk = state["next_chunk"]
+
+    fracs = jnp.asarray([0.1 * r for r in ratios], jnp.float32)
+    stats_fn = _stats_forward(cfg)
+    t0 = time.monotonic()
+    next_chunk = start_chunk
+
+    for chunk in sliding_windows(token_ids, max_length, stride):
+        if chunk.index < start_chunk:
+            continue
+        if max_chunks is not None and result.chunks >= max_chunks:
+            break
+        ids, targets = jnp.asarray(chunk.input_ids), jnp.asarray(chunk.target_ids)
+        stats, hiddens = stats_fn(params, ids)
+        next_chunk = chunk.index + 1
+        reg = regular_importance(stats.col_mean)  # (L, B, S)
+        for l, spec in enumerate(layers_of_interest):
+            if spec == "aggregate upto 2":
+                imp, codec = aggregate_upto(stats.col_mean, 2)[0], "affine_int8_rank"
+            elif spec == "maximum aggregation":
+                imp, codec = maximum_aggregation(stats.col_mean, 2)[0], "affine_int8_rank"
+            elif spec == "upto ratio":
+                imp, codec = reg[quant_layer, 0], "affine_int8_top_rho"
+            else:
+                imp, codec = reg[int(spec), 0], "affine_int8_rank"
+            nlls = _suffix_sweep(cfg, quant_layer, codec)(
+                params, hiddens[quant_layer], targets, imp, fracs)
+            result.total_nll[l] += np.asarray(nlls)
+        result.n_tokens += chunk.num_loss_tokens
+        result.chunks += 1
+        if result.chunks % checkpoint_every == 0:
+            _save_checkpoint(checkpoint_path, result, chunk.index + 1)
+            _emit(metrics_path, {"chunk": chunk.index, "ppl": result.ppl().tolist()})
+    result.wall_s = time.monotonic() - t0
+    _save_checkpoint(checkpoint_path, result, next_chunk)
+    _emit(metrics_path, {"final": True, "chunks": result.chunks,
+                         "ppl": result.ppl().tolist(), "wall_s": result.wall_s})
+    return result
+
+
+def run_channel_sweep(
+    cfg: ModelConfig,
+    params,
+    token_ids: np.ndarray,
+    *,
+    methods: Sequence[str],
+    layers_of_interest: Sequence[int],
+    max_length: int,
+    stride: int,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1000,
+    metrics_path: Optional[str] = None,
+    max_chunks: Optional[int] = None,
+) -> SweepResult:
+    """Per-channel codec sweep (``channel_wise.py:10-78``): methods x layers,
+    token-weighted NLL, no importance scoring."""
+    bad = [l for l in layers_of_interest if not 0 <= int(l) < cfg.num_layers]
+    if bad:
+        raise ValueError(f"layers_of_interest {bad} out of range for a "
+                         f"{cfg.num_layers}-layer model")
+    shape = (len(methods), len(layers_of_interest))
+    result = SweepResult(
+        axes={"methods": list(methods), "layers_of_interest": list(layers_of_interest)},
+        total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="token_weighted")
+    start_chunk = 0
+    if (state := _load_checkpoint(checkpoint_path, result.axes)) is not None:
+        result.total_nll = np.asarray(state["total_nll"])
+        result.n_tokens, result.chunks = state["n_tokens"], state["chunks"]
+        start_chunk = state["next_chunk"]
+
+    fwd = _plain_forward(cfg)
+    t0 = time.monotonic()
+    next_chunk = start_chunk
+    for chunk in sliding_windows(token_ids, max_length, stride):
+        if chunk.index < start_chunk:
+            continue
+        if max_chunks is not None and result.chunks >= max_chunks:
+            break
+        ids, targets = jnp.asarray(chunk.input_ids), jnp.asarray(chunk.target_ids)
+        hiddens = fwd(params, ids)
+        next_chunk = chunk.index + 1
+        for m, method in enumerate(methods):
+            for l, layer in enumerate(layers_of_interest):
+                nll = _suffix_channel(cfg, int(layer), method)(params, hiddens[layer], targets)
+                result.total_nll[m, l] += float(nll) * chunk.num_loss_tokens
+        result.n_tokens += chunk.num_loss_tokens
+        result.chunks += 1
+        if result.chunks % checkpoint_every == 0:
+            _save_checkpoint(checkpoint_path, result, chunk.index + 1)
+            _emit(metrics_path, {"chunk": chunk.index, "ppl": result.ppl().tolist()})
+    result.wall_s = time.monotonic() - t0
+    _save_checkpoint(checkpoint_path, result, next_chunk)
+    _emit(metrics_path, {"final": True, "chunks": result.chunks,
+                         "ppl": result.ppl().tolist(), "wall_s": result.wall_s})
+    return result

@@ -1,0 +1,31 @@
+"""Token- and head-importance scoring.
+
+``metrics`` — attention-statistic metrics (column-mean, last-row, aggregates) that
+consume the reduced :class:`~edgellm_tpu.models.transformer.AttnStats` captured by
+the model forward, replacing the reference's second eager-attention model instance.
+``relevance`` — LRP-style attention-head relevance (the reference's ``lxt`` path) as
+explicit JAX vjp rules.
+"""
+from .metrics import (
+    ATTENTION_METHODS,
+    regular_importance,
+    weighted_importance,
+    last_row_importance,
+    aggregate_till,
+    importance_per_layer,
+    aggregate_upto,
+    maximum_aggregation,
+    ordering_from_importance,
+)
+
+__all__ = [
+    "ATTENTION_METHODS",
+    "regular_importance",
+    "weighted_importance",
+    "last_row_importance",
+    "aggregate_till",
+    "importance_per_layer",
+    "aggregate_upto",
+    "maximum_aggregation",
+    "ordering_from_importance",
+]

@@ -1,0 +1,93 @@
+"""Attention-statistic token-importance metrics, vectorized over layers.
+
+The reference computes these from full (B, H, S, S) eager attention maps produced by
+a *second* model instance (``/root/reference/Experiments/Qwen2-0.5B/main.py:21-98``,
+``Experiments/Pythia-70M/last_row_exp.py:9-45``, ``initial_exp.py:27-72``). Every
+metric only ever consumes two reductions of the map — the column-wise mean (average
+attention *received* per key position) and the last query row — so here they operate
+on the (L, B, H, S) reduced statistics captured in the main forward pass
+(:class:`edgellm_tpu.models.transformer.AttnStats`), eliminating both the second
+model and the O(S^2) HBM traffic.
+
+Shape convention: ``col_mean``/``last_row`` are (L, B, H, S); per-layer importance
+outputs are (L, B, S); single aggregated outputs are (B, S).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+#: methods accepted by ``importance_per_layer`` — the reference's four
+#: (``Qwen2-0.5B/main.py:46-92``).
+ATTENTION_METHODS = (
+    "regular_importance",
+    "weighted_importance",
+    "last_row",
+    "aggregate_till",
+)
+
+
+def regular_importance(col_mean: jnp.ndarray) -> jnp.ndarray:
+    """Head-mean of the column-wise attention mean, per layer.
+
+    Matches ``mean(heads) -> mean(queries)`` of ``main.py:46-56`` (the two means
+    commute; the query mean is already folded into ``col_mean``).
+    """
+    return jnp.mean(col_mean, axis=2)
+
+
+def weighted_importance(col_mean: jnp.ndarray, head_weights: jnp.ndarray) -> jnp.ndarray:
+    """Per-head column means combined with LRP head weights (``main.py:57-78``).
+
+    ``head_weights``: (L, H), typically normalized to sum 1 per layer (the
+    reference's 24x14 LRP output). The reference takes a weighted *sum* over heads
+    (no extra normalization), then the column mean — reproduced exactly.
+    """
+    return jnp.einsum("lbhs,lh->lbs", col_mean, head_weights)
+
+
+def last_row_importance(last_row: jnp.ndarray) -> jnp.ndarray:
+    """Head-mean of the final query row (``main.py:80-86``)."""
+    return jnp.mean(last_row, axis=2)
+
+
+def aggregate_till(col_mean: jnp.ndarray) -> jnp.ndarray:
+    """Running mean of regular importance over layers 0..l (``main.py:87-92``)."""
+    reg = regular_importance(col_mean)  # (L, B, S)
+    counts = jnp.arange(1, reg.shape[0] + 1, dtype=reg.dtype)[:, None, None]
+    return jnp.cumsum(reg, axis=0) / counts
+
+
+def importance_per_layer(stats, method: str,
+                         head_weights: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Dispatch one of the four reference methods -> (L, B, S) importance."""
+    if method == "regular_importance":
+        return regular_importance(stats.col_mean)
+    if method == "weighted_importance":
+        if head_weights is None:
+            raise ValueError("weighted_importance requires head_weights (L, H)")
+        return weighted_importance(stats.col_mean, head_weights)
+    if method == "last_row":
+        return last_row_importance(stats.last_row)
+    if method == "aggregate_till":
+        return aggregate_till(stats.col_mean)
+    raise ValueError(f"unknown method {method!r}; options: {ATTENTION_METHODS}")
+
+
+def aggregate_upto(col_mean: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Mean of regular importance over layers 0..k inclusive (``initial_exp.py:31-40``,
+    the ``'aggregate upto 2'`` ordering with k=2)."""
+    return jnp.mean(regular_importance(col_mean)[: k + 1], axis=0)
+
+
+def maximum_aggregation(col_mean: jnp.ndarray, k: int = None) -> jnp.ndarray:
+    """Elementwise max of per-layer regular importance (``initial_exp.py:41-51``;
+    the reference maxes over layers 0..2, i.e. k=2)."""
+    reg = regular_importance(col_mean)
+    upto = reg if k is None else reg[: k + 1]
+    return jnp.max(upto, axis=0)
+
+
+def ordering_from_importance(importance: jnp.ndarray) -> jnp.ndarray:
+    """Ascending stable argsort — least-important positions first
+    (``initial_exp.py:39,50,70``)."""
+    return jnp.argsort(importance, axis=-1)

@@ -130,4 +130,7 @@ PRESETS = {
     "pythia-70m": PYTHIA_70M,
     "qwen2-0.5b": QWEN2_0_5B,
     "qwen2-1.5b": QWEN2_1_5B,
+    # CI/smoke-scale variants (random init, no pretrained weights needed)
+    "tiny-neox": tiny_config("gpt_neox"),
+    "tiny-qwen2": tiny_config("qwen2", num_layers=6),
 }

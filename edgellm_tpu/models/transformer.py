@@ -251,6 +251,20 @@ def forward(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray, *,
     return logits, aux
 
 
+def run_layers_from_ids(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray, *,
+                        capture_stats: bool = False,
+                        compute_dtype: jnp.dtype = jnp.float32):
+    """Prefix pass for sweep drivers: embed -> all layers, collecting every
+    post-block hidden state, WITHOUT the final norm/unembed (suffix runs redo the
+    tail from a cached boundary activation, so logits here would be dead compute).
+    """
+    params = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype)
+                                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    hidden = embed(params, input_ids)
+    return run_layers(cfg, params, hidden, capture_stats=capture_stats,
+                      collect_hidden=True)
+
+
 def nll_from_logits(logits: jnp.ndarray, target_ids: jnp.ndarray,
                     per_example: bool = False) -> jnp.ndarray:
     """Shifted cross-entropy with -100 masking — the reference's NLL definition

@@ -1,0 +1,169 @@
+"""CLI sweep driver, compatible with the reference's ``params.json`` convention.
+
+The reference drives each experiment with ``python main.py`` next to a flat
+``params.json`` (keys: ``ratios``, ``layers_of_interest``, ``stride``,
+``max_length``, ``experiment``, ``methods`` — ``Pythia-70M/main.py:23-32``,
+``Qwen2-0.5B/main.py:107-119``). Here one entry point covers every experiment:
+
+    python -m edgellm_tpu.run --params params.json --model qwen2-0.5b \
+        --corpus corpus.npy [--weights ckpt.safetensors] [--output-dir out]
+
+Dispatch mirrors the reference:
+- ``experiment: "initial"``   -> Pythia initial sweep (affine-int8 rank / top-rho)
+- ``experiment: "last_row"``  -> token-selective int4 sweep (Pythia defaults)
+- ``experiment: "relevance"`` -> LRP head-relevance extraction
+- methods containing "channel" -> per-channel codec sweep (``main.py:118-119``)
+- otherwise                   -> the Qwen-style token sweep
+
+Corpus input is a ``.npy``/``.npz`` of token ids, or a raw ``.txt`` plus
+``--tokenizer`` (a local HF tokenizer path; this environment has no network).
+Weights: a local torch checkpoint via ``--weights`` (state_dict ``.pt`` or
+HF directory), else random init (smoke/benchmark mode).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _load_corpus(args, vocab_size: int) -> np.ndarray:
+    if args.corpus is None:
+        rng = np.random.default_rng(args.seed)
+        return rng.integers(0, vocab_size, args.synthetic_corpus_len)
+    if args.corpus.endswith((".npy", ".npz")):
+        data = np.load(args.corpus)
+        if hasattr(data, "files"):
+            data = data[data.files[0]]
+        return np.asarray(data).reshape(-1)
+    # raw text: reproduce the reference's corpus construction — documents joined
+    # with "\n\n" (Qwen2-0.5B/main.py:122-124). A text file is assumed to already
+    # be the joined corpus.
+    if args.tokenizer is None:
+        raise SystemExit("--tokenizer is required for raw-text corpora")
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(args.tokenizer)
+    with open(args.corpus) as f:
+        text = f.read()
+    return np.asarray(tok(text, return_tensors="np").input_ids).reshape(-1)
+
+
+def _load_model(args):
+    import jax
+    from .models import PRESETS, init_params, params_from_state_dict, config_from_hf
+
+    if args.weights:
+        import torch
+
+        if os.path.isdir(args.weights):
+            from transformers import AutoConfig, AutoModelForCausalLM
+
+            hf_cfg = AutoConfig.from_pretrained(args.weights)
+            cfg = config_from_hf(hf_cfg)
+            model = AutoModelForCausalLM.from_pretrained(args.weights)
+            sd = model.state_dict()
+        else:
+            if args.model not in PRESETS:
+                raise SystemExit(f"--model must be one of {sorted(PRESETS)} with --weights file")
+            cfg = PRESETS[args.model]
+            sd = torch.load(args.weights, map_location="cpu")
+        return cfg, params_from_state_dict(cfg, sd)
+    cfg = PRESETS[args.model]
+    return cfg, init_params(cfg, jax.random.key(args.seed))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__,
+                                 formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--params", required=True, help="reference-style params.json")
+    from .models import PRESETS
+
+    ap.add_argument("--model", default="qwen2-0.5b", choices=sorted(PRESETS),
+                    help="model preset")
+    ap.add_argument("--corpus", help=".npy/.npz token ids or raw .txt (with --tokenizer); "
+                                     "omitted -> synthetic corpus (smoke mode)")
+    ap.add_argument("--tokenizer", help="local HF tokenizer path for raw-text corpora")
+    ap.add_argument("--weights", help="local torch state_dict (.pt) or HF model dir; "
+                                      "omitted -> random init (smoke mode)")
+    ap.add_argument("--head-weights", help="LRP head weights .json (L x H) for weighted_importance")
+    ap.add_argument("--output-dir", default=".")
+    ap.add_argument("--max-chunks", type=int, help="stop after N chunks (smoke/CI)")
+    ap.add_argument("--checkpoint-every", type=int, default=1000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--synthetic-corpus-len", type=int, default=4096)
+    args = ap.parse_args(argv)
+
+    with open(args.params) as f:
+        params_json = json.load(f)
+
+    cfg, params = _load_model(args)
+    corpus = _load_corpus(args, cfg.vocab_size)
+    if corpus.max() >= cfg.vocab_size or corpus.min() < 0:
+        raise SystemExit(f"corpus token ids outside [0, {cfg.vocab_size}) — wrong tokenizer?")
+    os.makedirs(args.output_dir, exist_ok=True)
+    out = lambda name: os.path.join(args.output_dir, name)
+
+    experiment = params_json.get("experiment", "")
+    methods = params_json.get("methods", [])
+    max_length = params_json.get("max_length", cfg.max_position_embeddings)
+    stride = params_json.get("stride", 32)
+    common = dict(
+        max_length=max_length, stride=stride,
+        checkpoint_path=out("sweep_checkpoint.json"),
+        checkpoint_every=args.checkpoint_every,
+        metrics_path=out("metrics.jsonl"),
+        max_chunks=args.max_chunks,
+    )
+
+    if experiment == "relevance":
+        try:
+            from .importance.relevance import run_relevance_extraction
+        except ImportError as e:
+            raise SystemExit(f"relevance extraction unavailable: {e}") from e
+
+        weights = run_relevance_extraction(
+            cfg, params, corpus, max_length=max_length, stride=stride,
+            max_chunks=args.max_chunks)
+        with open(out("attention_head_weights.json"), "w") as f:
+            json.dump(np.asarray(weights).tolist(), f)
+        print(json.dumps({"artifact": out("attention_head_weights.json"),
+                          "shape": list(np.asarray(weights).shape)}))
+        return 0
+
+    from .eval import run_token_sweep, run_initial_sweep, run_channel_sweep
+
+    if experiment == "initial":
+        result = run_initial_sweep(
+            cfg, params, corpus, layers_of_interest=params_json["layers_of_interest"],
+            ratios=params_json["ratios"], **common)
+    elif methods and "channel" in methods[0]:
+        result = run_channel_sweep(
+            cfg, params, corpus, methods=methods,
+            layers_of_interest=params_json["layers_of_interest"], **common)
+    else:
+        head_weights = None
+        if args.head_weights:
+            with open(args.head_weights) as f:
+                head_weights = np.asarray(json.load(f))
+        elif "weighted_importance" in methods:
+            raise SystemExit("weighted_importance requires --head-weights "
+                             "(produce it with experiment: \"relevance\")")
+        result = run_token_sweep(
+            cfg, params, corpus, methods=methods or ["regular_importance"],
+            layers_of_interest=params_json["layers_of_interest"],
+            ratios=params_json["ratios"], head_weights=head_weights, **common)
+
+    with open(out("avg_ppl_results.json"), "w") as f:
+        json.dump(result.to_json(), f, indent=1)
+    print(json.dumps({"chunks": result.chunks, "n_tokens": result.n_tokens,
+                      "wall_s": round(result.wall_s, 3),
+                      "ppl": np.round(result.ppl(), 4).tolist()}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

