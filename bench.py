@@ -1,0 +1,67 @@
+"""Benchmark: Qwen2-0.5B importance-guided quantization sweep throughput.
+
+Reproduces the reference's headline workload — the Qwen2-0.5B sweep of
+``Experiments/Qwen2-0.5B/main.py``: per 32-token stride over a 512-token window,
+importance scoring for 4 methods from a full attention pass, then
+4 methods x 1 split layer x 5 ratios quantized evaluations. The reference runs
+1 eager + 20 quantized FULL forwards per chunk at ~16.0 s/chunk on its Colab GPU
+(``Notebooks/qwen2-0.5B_experiment.ipynb`` cell 12, BASELINE.md). Here the same
+sweep is one stats forward + vmapped layer suffixes.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+vs_baseline > 1 means faster than the reference's s/chunk on its hardware.
+
+Env knobs: BENCH_CHUNKS (default 8), BENCH_DTYPE (float32|bfloat16, default
+bfloat16 — TPU MXU native; fp32 PPL parity is the CPU test suite's job).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_S_PER_CHUNK = 16.0  # qwen2-0.5B_experiment.ipynb cell 12 (BASELINE.md)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import QWEN2_0_5B as cfg, init_params
+    from edgellm_tpu.eval import run_token_sweep
+
+    n_chunks = int(os.environ.get("BENCH_CHUNKS", "8"))
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    # corpus long enough for n_chunks full 512-token windows at stride 32 + warmup
+    corpus = rng.integers(0, cfg.vocab_size, 512 + 32 * (n_chunks + 2))
+    head_weights = rng.random((cfg.num_layers, cfg.num_heads)).astype(np.float32)
+    head_weights /= head_weights.sum(axis=1, keepdims=True)
+
+    kw = dict(
+        methods=["regular_importance", "weighted_importance", "last_row", "aggregate_till"],
+        layers_of_interest=[11],
+        ratios=[0.0, 0.25, 0.5, 0.75, 1.0],
+        max_length=512, stride=32, head_weights=head_weights,
+    )
+
+    # warmup: compile both chunk shapes out of band
+    run_token_sweep(cfg, params, corpus, max_chunks=1, **kw)
+
+    t0 = time.monotonic()
+    result = run_token_sweep(cfg, params, corpus, max_chunks=n_chunks, **kw)
+    elapsed = time.monotonic() - t0
+    s_per_chunk = elapsed / result.chunks
+
+    print(json.dumps({
+        "metric": "qwen2-0.5b sweep time per 32-token chunk (4 methods x 1 layer x 5 ratios)",
+        "value": round(s_per_chunk, 4),
+        "unit": "s/chunk",
+        "vs_baseline": round(REFERENCE_S_PER_CHUNK / s_per_chunk, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

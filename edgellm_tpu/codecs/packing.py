@@ -1,0 +1,219 @@
+"""Packed wire codecs: the bytes that actually cross the device boundary.
+
+The reference never packs anything — its quantization is an in-place fp
+quantize->dequantize and its compression claims are analytic bit counts
+(SURVEY.md section 5, ``BASELINE.md``). Here every codec has a real packed
+representation: ``encode`` produces integer payload buffers (int4 nibbles packed
+two-per-byte, ternary codes four-per-byte) plus fp scales, ``decode`` inverts the
+packing, and ``payload_bytes`` is measured from the buffers that cross
+``lax.ppermute`` in the split runtime — not asserted.
+
+Numerical contract: for every codec, ``decode(encode(x))`` equals the matching
+*simulate* codec's quantize->dequantize output exactly (tested), so a split run
+with a wire codec reproduces the reference's simulated-quantization perplexities
+while moving real compressed bytes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def pack_int4(codes: jnp.ndarray) -> jnp.ndarray:
+    """Pack int4 codes in [-8, 7] (last axis even) into uint8, two per byte."""
+    u = (codes.astype(jnp.int32) + 8).astype(jnp.uint8)  # [0, 15]
+    lo, hi = u[..., 0::2], u[..., 1::2]
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_int4` -> int8 codes in [-8, 7]."""
+    lo = (packed & 0xF).astype(jnp.int8) - 8
+    hi = (packed >> 4).astype(jnp.int8) - 8
+    out = jnp.stack([lo, hi], axis=-1)
+    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+
+
+def pack_ternary(codes: jnp.ndarray) -> jnp.ndarray:
+    """Pack ternary codes in {-1, 0, 1} (last axis % 4 == 0) into uint8, four per byte."""
+    u = (codes.astype(jnp.int32) + 1).astype(jnp.uint8)  # [0, 2], 2 bits each
+    return (u[..., 0::4] | (u[..., 1::4] << 2) | (u[..., 2::4] << 4)
+            | (u[..., 3::4] << 6))
+
+
+def unpack_ternary(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_ternary` -> int8 codes in {-1, 0, 1}."""
+    parts = [((packed >> (2 * i)) & 0x3).astype(jnp.int8) - 1 for i in range(4)]
+    out = jnp.stack(parts, axis=-1)
+    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 4)
+
+
+def _nbytes(tree) -> int:
+    return int(sum(np.prod(a.shape) * a.dtype.itemsize
+                   for a in jax.tree_util.tree_leaves(tree)))
+
+
+@dataclasses.dataclass(frozen=True)
+class WireCodec:
+    """One boundary codec: ``encode(hidden) -> payload`` (pytree of arrays that
+    cross the wire), ``decode(payload) -> hidden``. ``payload_bytes`` measures the
+    encoded size of one (B, S, D) activation.
+
+    ``batch_invariant``: True when encode/decode treat batch rows independently
+    (per-token codecs, identity casts). Codecs whose scales reduce over the batch
+    or sequence axes (global / per-channel) are NOT safe under data-parallel
+    sharding of the batch axis — each shard would compute a different scale than
+    a single-device run; the split runtime rejects that combination."""
+
+    name: str
+    encode: Callable
+    decode: Callable
+    batch_invariant: bool = True
+
+    def payload_bytes(self, hidden_shape, dtype=jnp.float32) -> int:
+        spec = jax.ShapeDtypeStruct(hidden_shape, dtype)
+        return _nbytes(jax.eval_shape(self.encode, spec))
+
+
+def _identity_codec(name: str, dtype) -> WireCodec:
+    return WireCodec(
+        name=name,
+        encode=lambda h: {"x": h.astype(dtype)},
+        decode=lambda p: p["x"].astype(jnp.float32),
+    )
+
+
+def _int8_per_token() -> WireCodec:
+    """Per-token affine int8: D bytes + 2 fp32 scalars (scale, min) per token
+    (the intent of ``pythia_model.py:57-68``). The zero-point is recomputed from
+    (scale, min) on the decode side; constant tokens (scale == 0) reconstruct to
+    exactly ``min`` — matching the simulate codec's pass-through."""
+
+    def encode(h):
+        mn = jnp.min(h, axis=-1, keepdims=True)
+        mx = jnp.max(h, axis=-1, keepdims=True)
+        scale = (mx - mn) / 255.0
+        safe = jnp.where(scale > 0, scale, 1.0)
+        zp = jnp.round(-128.0 - mn / safe)
+        q = jnp.clip(jnp.round(h / safe) + zp, -128, 127).astype(jnp.int8)
+        return {"q": q, "scale": scale, "mn": mn}
+
+    def decode(p):
+        safe = jnp.where(p["scale"] > 0, p["scale"], 1.0)
+        zp = jnp.round(-128.0 - p["mn"] / safe)
+        deq = (p["q"].astype(jnp.float32) - zp) * safe
+        return jnp.where(p["scale"] > 0, deq, p["mn"])
+
+    return WireCodec("int8_per_token", encode, decode)
+
+
+def _int4_global() -> WireCodec:
+    """Symmetric int4 with one global max-abs scale — the packed twin of the
+    reference's headline simulated codec (``qwen_layer_wise.py:58-70``)."""
+
+    def encode(h):
+        max_val = jnp.max(jnp.abs(h))
+        safe = jnp.where(max_val > 0, max_val, 1.0)
+        codes = jnp.round(jnp.clip(h / safe * 7.0, -8.0, 7.0)).astype(jnp.int8)
+        return {"packed": pack_int4(codes), "scale": safe[None]}
+
+    def decode(p):
+        return unpack_int4(p["packed"]).astype(jnp.float32) / 7.0 * p["scale"][0]
+
+    return WireCodec("int4_global", encode, decode, batch_invariant=False)
+
+
+def _int4_per_token() -> WireCodec:
+    """Symmetric int4, one max-abs scale per token (D/2 bytes + 4 per token)."""
+
+    def encode(h):
+        max_val = jnp.max(jnp.abs(h), axis=-1, keepdims=True)
+        safe = jnp.where(max_val > 0, max_val, 1.0)
+        codes = jnp.round(jnp.clip(h / safe * 7.0, -8.0, 7.0)).astype(jnp.int8)
+        return {"packed": pack_int4(codes), "scale": safe}
+
+    def decode(p):
+        return unpack_int4(p["packed"]).astype(jnp.float32) / 7.0 * p["scale"]
+
+    return WireCodec("int4_per_token", encode, decode)
+
+
+def _ternary(kind: str) -> WireCodec:
+    """Per-channel ternary (packed twin of ``channel_1_mean`` / ``channel_1_max``,
+    ``qwen_layer_wise.py:135-150``): D/4 bytes per token + D fp32 channel scales."""
+
+    def encode(h):
+        if kind == "mean":
+            scale = jnp.mean(h, axis=(0, 1), keepdims=True) + 1e-8
+            codes = jnp.clip(jnp.round(h / scale), -1, 1).astype(jnp.int8)
+        else:
+            cmax = jnp.max(jnp.abs(h), axis=(0, 1), keepdims=True)
+            scale = jnp.where(cmax > 0, cmax, 1.0)
+            codes = jnp.clip(jnp.round(h / scale), -1, 1).astype(jnp.int8)
+        return {"packed": pack_ternary(codes), "scale": scale}
+
+    def decode(p):
+        return unpack_ternary(p["packed"]).astype(jnp.float32) * p["scale"]
+
+    return WireCodec(f"ternary_{kind}", encode, decode, batch_invariant=False)
+
+
+def _int8_per_channel() -> WireCodec:
+    """Per-channel symmetric int8 (packed twin of ``channel_8``)."""
+
+    def encode(h):
+        # an all-zero channel encodes to zero codes and decodes to exactly zero,
+        # so no zero-channel sidecar is needed
+        cmax = jnp.max(jnp.abs(h), axis=(0, 1), keepdims=True)
+        safe = jnp.where(cmax > 0, cmax, 1.0)
+        codes = jnp.round(h / safe * 127.0).astype(jnp.int8)
+        return {"q": codes, "scale": safe}
+
+    def decode(p):
+        return p["q"].astype(jnp.float32) * p["scale"] / 127.0
+
+    return WireCodec("int8_per_channel", encode, decode, batch_invariant=False)
+
+
+def _int4_per_channel() -> WireCodec:
+    """Per-channel symmetric int4 (packed twin of ``channel_4``)."""
+
+    def encode(h):
+        cmax = jnp.max(jnp.abs(h), axis=(0, 1), keepdims=True)
+        safe = jnp.where(cmax > 0, cmax, 1.0)
+        codes = jnp.round(h / safe * 7.0).astype(jnp.int8)
+        return {"packed": pack_int4(codes), "scale": safe}
+
+    def decode(p):
+        return unpack_int4(p["packed"]).astype(jnp.float32) * p["scale"] / 7.0
+
+    return WireCodec("int4_per_channel", encode, decode, batch_invariant=False)
+
+
+def get_wire_codec(name: str) -> WireCodec:
+    """Codec registry. Names map to the reference's boundary compression schemes
+    (fp16 is its notional uncompressed transfer baseline, BASELINE.md)."""
+    factories = {
+        "fp32": lambda: _identity_codec("fp32", jnp.float32),
+        "bf16": lambda: _identity_codec("bf16", jnp.bfloat16),
+        "fp16": lambda: _identity_codec("fp16", jnp.float16),
+        "int8_per_token": _int8_per_token,
+        "int8_per_channel": _int8_per_channel,
+        "int4_global": _int4_global,
+        "int4_per_token": _int4_per_token,
+        "int4_per_channel": _int4_per_channel,
+        "ternary_mean": lambda: _ternary("mean"),
+        "ternary_max": lambda: _ternary("max"),
+    }
+    if name not in factories:
+        raise ValueError(f"unknown wire codec {name!r}; options: {sorted(factories)}")
+    return factories[name]()
+
+
+WIRE_CODECS = ("fp32", "bf16", "fp16", "int8_per_token", "int8_per_channel",
+               "int4_global", "int4_per_token", "int4_per_channel",
+               "ternary_mean", "ternary_max")

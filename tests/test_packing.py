@@ -1,0 +1,106 @@
+"""Wire-codec tests: bit-exact pack/unpack round-trips, parity with the simulate
+codecs (the wire codec must reproduce the reference's simulated quantization
+exactly while producing real packed bytes), and measured byte accounting against
+the analytic table in BASELINE.md.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.codecs.packing import (
+    pack_int4, unpack_int4, pack_ternary, unpack_ternary,
+    get_wire_codec, WIRE_CODECS,
+)
+from edgellm_tpu.codecs import (
+    int4_token_select, per_token_affine_int8, channel_wise_quant,
+)
+
+
+@pytest.fixture
+def hidden(rng):
+    return jnp.asarray(rng.normal(size=(2, 16, 24)).astype(np.float32))
+
+
+def test_int4_pack_roundtrip_exact(rng):
+    codes = jnp.asarray(rng.integers(-8, 8, size=(3, 5, 32), dtype=np.int64).astype(np.int8))
+    packed = pack_int4(codes)
+    assert packed.dtype == jnp.uint8 and packed.shape == (3, 5, 16)
+    np.testing.assert_array_equal(np.asarray(unpack_int4(packed)), np.asarray(codes))
+
+
+def test_ternary_pack_roundtrip_exact(rng):
+    codes = jnp.asarray(rng.integers(-1, 2, size=(2, 7, 16), dtype=np.int64).astype(np.int8))
+    packed = pack_ternary(codes)
+    assert packed.dtype == jnp.uint8 and packed.shape == (2, 7, 4)
+    np.testing.assert_array_equal(np.asarray(unpack_ternary(packed)), np.asarray(codes))
+
+
+@pytest.mark.parametrize("name", WIRE_CODECS)
+def test_decode_encode_is_finite_and_close(hidden, name):
+    codec = get_wire_codec(name)
+    out = codec.decode(codec.encode(hidden))
+    assert out.shape == hidden.shape
+    assert np.isfinite(np.asarray(out)).all()
+    # even ternary should stay within a broad band of the input
+    assert float(jnp.max(jnp.abs(out - hidden))) < 10.0
+
+
+def test_int4_global_matches_simulate(hidden):
+    """Wire int4_global == simulate int4 with every token selected."""
+    codec = get_wire_codec("int4_global")
+    wire = codec.decode(codec.encode(hidden))
+    sim = int4_token_select(hidden, jnp.arange(hidden.shape[1], 0.0, -1.0), 1.0)
+    np.testing.assert_array_equal(np.asarray(wire), np.asarray(sim))
+
+
+def test_int8_per_token_matches_simulate(hidden):
+    codec = get_wire_codec("int8_per_token")
+    wire = codec.decode(codec.encode(hidden))
+    sim = per_token_affine_int8(hidden)
+    np.testing.assert_array_equal(np.asarray(wire), np.asarray(sim))
+
+
+def test_int8_per_token_constant_token_passthrough():
+    h = jnp.full((1, 4, 8), 0.4)
+    codec = get_wire_codec("int8_per_token")
+    np.testing.assert_allclose(np.asarray(codec.decode(codec.encode(h))), 0.4, atol=1e-7)
+
+
+@pytest.mark.parametrize("wire,channel", [
+    ("int8_per_channel", "channel_8"),
+    ("int4_per_channel", "channel_4"),
+    ("ternary_mean", "channel_1_mean"),
+    ("ternary_max", "channel_1_max"),
+])
+def test_per_channel_wire_matches_simulate(hidden, wire, channel):
+    codec = get_wire_codec(wire)
+    got = codec.decode(codec.encode(hidden))
+    want = channel_wise_quant(hidden, channel)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+def test_payload_bytes_match_baseline_table():
+    """BASELINE.md analytic boundary payloads, now measured: Qwen d=896 ->
+    fp16 1792 B/tok, int8 896+scales, int4 448+scales, ternary 224+scales."""
+    S, D = 512, 896
+    per_tok = lambda name: get_wire_codec(name).payload_bytes((1, S, D)) / S
+    assert per_tok("fp16") == 1792
+    assert per_tok("fp32") == 3584
+    q8 = per_tok("int8_per_token")
+    assert 896 <= q8 <= 896 + 16  # + 2 fp32 scalars/token
+    q4 = per_tok("int4_per_token")
+    assert 448 <= q4 <= 448 + 8
+    t = per_tok("ternary_max")
+    assert 224 <= t <= 224 + 8  # + D fp32 channel scales amortized over S
+    ch8 = per_tok("int8_per_channel")
+    assert 896 <= ch8 <= 896 + 8
+
+
+def test_codecs_jit_and_shapes_static(hidden):
+    for name in WIRE_CODECS:
+        codec = get_wire_codec(name)
+        f = jax.jit(lambda h, c=codec: c.decode(c.encode(h)))
+        out = f(hidden)
+        assert out.shape == hidden.shape
