@@ -1,0 +1,207 @@
+"""LRP attention-head relevance — the reference's ``lxt`` path, in functional JAX.
+
+The reference monkey-patches the torch Qwen2 module classes with ``lxt.efficient``
+LRP rules, hooks every layer's softmaxed attention probabilities with
+``retain_grad``, seeds the backward pass with the max last-position logit
+(``max_logits.backward(max_logits)``), and scores each head by the total
+attention-times-gradient mass ``sum(A * dA)``
+(``/root/reference/Experiments/Relevance/main.py:21-128``).
+
+JAX has no modules to patch; the same semantics are explicit here:
+
+- **LRP rules as custom gradients**: normalization layers propagate relevance as
+  if the normalizer were a constant (``stop_gradient`` on the rsqrt factor —
+  lxt's identity rule for RMSNorm/LayerNorm), and the SwiGLU elementwise product
+  splits relevance equally between its factors (uniform rule, a ``custom_vjp``).
+  These are what ``lxt.efficient.monkey_patch`` rewires in ``Qwen2RMSNorm`` /
+  ``Qwen2MLP`` (``Notebooks/attention_head_weights_via_relevance.ipynb`` cell 4).
+- **retain_grad equivalent**: attention probabilities are materialized with an
+  additive zero "offset" input per layer; one ``jax.vjp`` against the offsets
+  yields exactly ``dSeed/dA`` alongside ``A`` from the same pass.
+- **Accumulation/normalization**: per (layer, head) relevance summed over chunks,
+  then normalized per layer to sum 1 (signed sums, zero-sum guarded with the
+  reference's 1e-9 divisor — ``Relevance/main.py:111-118``).
+
+The gradient-checkpointing the reference needs for memory
+(``Relevance/main.py:63``) is ``jax.checkpoint`` on the per-layer scan body.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.configs import ModelConfig
+from ..models.transformer import apply_rotary, embed, precompute_rope
+from ..eval.windowing import sliding_windows
+
+
+@jax.custom_vjp
+def uniform_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise product with the LRP uniform rule: relevance splits 50/50
+    between the factors (gradient*input of each factor gets half the output's)."""
+    return a * b
+
+
+def _uniform_mul_fwd(a, b):
+    return a * b, (a, b)
+
+
+def _uniform_mul_bwd(res, g):
+    a, b = res
+    return 0.5 * g * b, 0.5 * g * a
+
+
+uniform_mul.defvjp(_uniform_mul_fwd, _uniform_mul_bwd)
+
+
+def _rmsnorm_lrp(x, scale, eps):
+    xf = x.astype(jnp.float32)
+    denom = jax.lax.stop_gradient(jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps))
+    return (xf * denom) * scale
+
+
+def _layernorm_lrp(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    denom = jax.lax.stop_gradient(jax.lax.rsqrt(jnp.var(xf, -1, keepdims=True) + eps))
+    return (xf - mu) * denom * scale + bias
+
+
+def _lrp_attention(cfg: ModelConfig, lp: dict, x, cos, sin, probs_offset):
+    """Eager attention returning the (differentiable) probability tensor.
+
+    ``probs_offset`` (B, H, S, S) is added to the post-softmax probabilities; the
+    caller passes zeros and differentiates against it — the JAX equivalent of
+    ``retain_grad`` on the probs (``Relevance/main.py:36-38``).
+    """
+    b, s, d = x.shape
+    h, kv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    q = (x @ lp["wq"]).reshape(b, s, h, hd)
+    k = (x @ lp["wk"]).reshape(b, s, kv, hd)
+    v = (x @ lp["wv"]).reshape(b, s, kv, hd)
+    if "bq" in lp:
+        q = q + lp["bq"].reshape(h, hd)
+        k = k + lp["bk"].reshape(kv, hd)
+        v = v + lp["bv"].reshape(kv, hd)
+    q = apply_rotary(q, cos, sin, cfg.rotary_dim)
+    k = apply_rotary(k, cos, sin, cfg.rotary_dim)
+    rep = h // kv
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k,
+                        preferred_element_type=jnp.float32) / jnp.sqrt(
+                            jnp.asarray(hd, jnp.float32))
+    causal = jnp.tril(jnp.ones((s, s), dtype=bool))
+    scores = jnp.where(causal[None, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1) + probs_offset
+    out = jnp.einsum("bhst,bthd->bshd", probs.astype(x.dtype), v,
+                     preferred_element_type=jnp.float32).astype(x.dtype)
+    out = out.reshape(b, s, h * hd) @ lp["wo"]
+    if "bo" in lp:
+        out = out + lp["bo"]
+    return out, probs
+
+
+def _lrp_mlp(cfg: ModelConfig, lp: dict, x):
+    if cfg.family == "gpt_neox":
+        # the reference's lxt patch list covers Qwen2 (no GELU rule needed for its
+        # experiment); GELU keeps its standard gradient here
+        hidden = jax.nn.gelu(x @ lp["w_in"] + lp["b_in"], approximate=False)
+        return hidden @ lp["w_out"] + lp["b_out"]
+    return uniform_mul(jax.nn.silu(x @ lp["w_gate"]), x @ lp["w_up"]) @ lp["w_down"]
+
+
+def _lrp_block(cfg: ModelConfig, lp: dict, hidden, cos, sin, probs_offset):
+    if cfg.family == "gpt_neox":
+        attn_in = _layernorm_lrp(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
+        attn_out, probs = _lrp_attention(cfg, lp, attn_in, cos, sin, probs_offset)
+        mlp_in = _layernorm_lrp(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
+        return hidden + attn_out + _lrp_mlp(cfg, lp, mlp_in), probs
+    attn_in = _rmsnorm_lrp(hidden, lp["ln1_scale"], cfg.norm_eps)
+    attn_out, probs = _lrp_attention(cfg, lp, attn_in, cos, sin, probs_offset)
+    hidden = hidden + attn_out
+    mlp_in = _rmsnorm_lrp(hidden, lp["ln2_scale"], cfg.norm_eps)
+    return hidden + _lrp_mlp(cfg, lp, mlp_in), probs
+
+
+def lrp_forward(cfg: ModelConfig, params: dict, input_ids, probs_offsets):
+    """ids + per-layer probability offsets -> (logits, stacked probs).
+
+    One ``lax.scan`` over the stacked layers, rematerialized per layer
+    (``jax.checkpoint``) so the backward pass recomputes activations instead of
+    storing them — the reference's ``gradient_checkpointing_enable``.
+    """
+    hidden = embed(params, input_ids)
+    cos, sin = precompute_rope(cfg, input_ids.shape[1])
+
+    @jax.checkpoint
+    def body(h, xs):
+        lp, off = xs
+        h, probs = _lrp_block(cfg, lp, h, cos, sin, off)
+        return h, probs
+
+    hidden, probs = jax.lax.scan(body, hidden, (params["layers"], probs_offsets))
+    if cfg.family == "gpt_neox":
+        post = _layernorm_lrp(hidden, params["final_norm_scale"],
+                              params["final_norm_bias"], cfg.norm_eps)
+    else:
+        post = _rmsnorm_lrp(hidden, params["final_norm_scale"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("bsd,dv->bsv", post, head, preferred_element_type=jnp.float32)
+    return logits, probs
+
+
+@functools.lru_cache(maxsize=None)
+def _chunk_relevance(cfg: ModelConfig):
+    """Jitted: ids -> per-(layer, head) relevance for one chunk."""
+
+    @jax.jit
+    def fn(params, ids):
+        L, b, s = cfg.num_layers, ids.shape[0], ids.shape[1]
+        offsets = jnp.zeros((L, b, cfg.num_heads, s, s), jnp.float32)
+
+        def f(off):
+            logits, probs = lrp_forward(cfg, params, ids, off)
+            # seed: per-row max logit at the last position; backward(max_logits)
+            # uses the value vector itself as the cotangent
+            # (Relevance/main.py:87-88) -- kept per-row so batch>1 matches
+            return jnp.max(logits[:, -1, :], axis=-1), probs
+
+        (seed, probs), vjp_fn = jax.vjp(f, offsets)
+        (grad_off,) = vjp_fn((seed, jnp.zeros_like(probs)))
+        return jnp.sum(probs * grad_off, axis=(1, 3, 4))  # (L, H)
+
+    return fn
+
+
+def run_relevance_extraction(
+    cfg: ModelConfig,
+    params,
+    token_ids: np.ndarray,
+    *,
+    max_length: int,
+    stride: int,
+    max_chunks: Optional[int] = None,
+    progress=None,
+) -> np.ndarray:
+    """Sliding-window accumulation of head relevance -> (L, H) weights,
+    normalized per layer to sum 1 (``Relevance/main.py:74-118``). The output is
+    the ``head_weights`` input of ``weighted_importance``."""
+    fn = _chunk_relevance(cfg)
+    total = np.zeros((cfg.num_layers, cfg.num_heads))
+    done = 0
+    for chunk in sliding_windows(token_ids, max_length, stride):
+        if max_chunks is not None and done >= max_chunks:
+            break
+        total += np.asarray(fn(params, jnp.asarray(chunk.input_ids)))
+        done += 1
+        if progress:
+            progress(chunk.index)
+    layer_sum = total.sum(axis=1, keepdims=True)
+    denom = np.where(layer_sum != 0, layer_sum, 1e-9)
+    return total / denom

@@ -1,0 +1,152 @@
+"""LRP relevance tests.
+
+``lxt`` is not installed in this environment (reference dep), so the oracle is an
+independent *torch autograd* implementation of the same LRP rules — detached
+normalizers, uniform product rule, probs with ``retain_grad``, seed
+``backward(max_logits)`` — built directly on the HF state_dict weights. Two
+different autograd engines computing the same modified-gradient semantics must
+agree on the per-head relevance.
+"""
+import numpy as np
+import pytest
+import torch
+
+from transformers import Qwen2Config, Qwen2ForCausalLM
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.models import config_from_hf, params_from_state_dict
+from edgellm_tpu.importance.relevance import (
+    uniform_mul, lrp_forward, run_relevance_extraction, _chunk_relevance,
+)
+
+torch.manual_seed(0)
+
+
+class _HalfProduct(torch.autograd.Function):
+    """torch twin of the uniform LRP product rule."""
+
+    @staticmethod
+    def forward(ctx, a, b):
+        ctx.save_for_backward(a, b)
+        return a * b
+
+    @staticmethod
+    def backward(ctx, g):
+        a, b = ctx.saved_tensors
+        return 0.5 * g * b, 0.5 * g * a
+
+
+def _torch_lrp_relevance(model, ids):
+    """Manual torch forward with LRP rules on the HF weights; returns (L, H)."""
+    cfg = model.config
+    sd = {k: v.float() for k, v in model.state_dict().items()}
+    h_, kv = cfg.num_attention_heads, cfg.num_key_value_heads
+    hd = cfg.hidden_size // h_
+    x = sd["model.embed_tokens.weight"][ids]  # (B, S, D)
+    B, S, D = x.shape
+
+    pos = torch.arange(S, dtype=torch.float32)
+    inv = 1.0 / (cfg.rope_theta ** (torch.arange(0, hd, 2, dtype=torch.float32) / hd))
+    freqs = torch.outer(pos, inv)
+    emb = torch.cat([freqs, freqs], dim=-1)
+    cos, sin = emb.cos(), emb.sin()
+
+    def rot(t):  # (B, S, H, hd)
+        c, s_ = cos[None, :, None, :], sin[None, :, None, :]
+        half = t.shape[-1] // 2
+        rotated = torch.cat([-t[..., half:], t[..., :half]], dim=-1)
+        return t * c + rotated * s_
+
+    def rmsnorm_lrp(v, w):
+        denom = torch.rsqrt(v.pow(2).mean(-1, keepdim=True) + cfg.rms_norm_eps).detach()
+        return v * denom * w
+
+    probs_saved = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"model.layers.{i}."
+        a_in = rmsnorm_lrp(x, sd[p + "input_layernorm.weight"])
+        q = (a_in @ sd[p + "self_attn.q_proj.weight"].T + sd[p + "self_attn.q_proj.bias"]).view(B, S, h_, hd)
+        k = (a_in @ sd[p + "self_attn.k_proj.weight"].T + sd[p + "self_attn.k_proj.bias"]).view(B, S, kv, hd)
+        v = (a_in @ sd[p + "self_attn.v_proj.weight"].T + sd[p + "self_attn.v_proj.bias"]).view(B, S, kv, hd)
+        q, k = rot(q), rot(k)
+        k = k.repeat_interleave(h_ // kv, dim=2)
+        v = v.repeat_interleave(h_ // kv, dim=2)
+        scores = torch.einsum("bshd,bthd->bhst", q, k) / np.sqrt(hd)
+        mask = torch.tril(torch.ones(S, S, dtype=torch.bool))
+        scores = scores.masked_fill(~mask, torch.finfo(torch.float32).min)
+        probs = torch.softmax(scores, dim=-1)
+        probs.requires_grad_(True)
+        probs.retain_grad()
+        probs_saved.append(probs)
+        attn = torch.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, h_ * hd)
+        x = x + attn @ sd[p + "self_attn.o_proj.weight"].T
+        m_in = rmsnorm_lrp(x, sd[p + "post_attention_layernorm.weight"])
+        gate = torch.nn.functional.silu(m_in @ sd[p + "mlp.gate_proj.weight"].T)
+        up = m_in @ sd[p + "mlp.up_proj.weight"].T
+        x = x + _HalfProduct.apply(gate, up) @ sd[p + "mlp.down_proj.weight"].T
+
+    post = rmsnorm_lrp(x, sd["model.norm.weight"])
+    logits = post @ sd["model.embed_tokens.weight"].T
+    max_logits, _ = torch.max(logits[:, -1, :], dim=-1)
+    max_logits.backward(max_logits)
+    rel = [(p * p.grad).sum(dim=(0, 2, 3)).detach().numpy() for p in probs_saved]
+    return np.stack(rel)
+
+
+@pytest.fixture(scope="module")
+def qwen_setup():
+    hf_cfg = Qwen2Config(
+        vocab_size=256, hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=128, max_position_embeddings=128,
+        rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=True,
+        attn_implementation="eager",
+    )
+    model = Qwen2ForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    params = params_from_state_dict(cfg, model.state_dict())
+    ids = np.random.default_rng(4).integers(0, 256, size=(1, 20))
+    return cfg, params, model, ids
+
+
+def test_uniform_mul_rule():
+    a, b = jnp.asarray([2.0, 3.0]), jnp.asarray([5.0, 7.0])
+    np.testing.assert_allclose(np.asarray(uniform_mul(a, b)), [10.0, 21.0])
+    ga, gb = jax.grad(lambda a, b: jnp.sum(uniform_mul(a, b)), argnums=(0, 1))(a, b)
+    np.testing.assert_allclose(np.asarray(ga), [2.5, 3.5])  # 0.5 * b
+    np.testing.assert_allclose(np.asarray(gb), [1.0, 1.5])  # 0.5 * a
+
+
+def test_lrp_forward_logits_match_standard_forward(qwen_setup):
+    """With zero offsets the LRP forward's primal equals the normal forward."""
+    from edgellm_tpu.models import forward
+
+    cfg, params, _, ids = qwen_setup
+    L, S = cfg.num_layers, ids.shape[1]
+    off = jnp.zeros((L, 1, cfg.num_heads, S, S))
+    lrp_logits, probs = lrp_forward(cfg, params, jnp.asarray(ids), off)
+    base_logits, _ = forward(cfg, params, jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(lrp_logits), np.asarray(base_logits),
+                               atol=1e-4, rtol=1e-4)
+    # probs rows sum to 1
+    np.testing.assert_allclose(np.asarray(probs.sum(-1)), 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("batch", [1, 2])
+def test_head_relevance_matches_torch_lrp_oracle(qwen_setup, batch):
+    cfg, params, model, _ = qwen_setup
+    ids = np.random.default_rng(4).integers(0, 256, size=(batch, 20))
+    got = np.asarray(_chunk_relevance(cfg)(params, jnp.asarray(ids)))
+    want = _torch_lrp_relevance(model, torch.tensor(ids))
+    assert got.shape == want.shape == (3, 4)
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
+def test_run_relevance_extraction_normalized(qwen_setup):
+    cfg, params, _, _ = qwen_setup
+    corpus = np.random.default_rng(9).integers(0, 256, 80)
+    w = run_relevance_extraction(cfg, params, corpus, max_length=32, stride=16,
+                                 max_chunks=3)
+    assert w.shape == (cfg.num_layers, cfg.num_heads)
+    np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-6)
