@@ -73,9 +73,15 @@ class WireCodec:
     encode: Callable
     decode: Callable
     batch_invariant: bool = True
+    #: True when ``encode`` takes (hidden, importance) — the split runtime must
+    #: supply a per-hop importance vector (token-selective mixed precision)
+    needs_importance: bool = False
 
     def payload_bytes(self, hidden_shape, dtype=jnp.float32) -> int:
         spec = jax.ShapeDtypeStruct(hidden_shape, dtype)
+        if self.needs_importance:
+            imp = jax.ShapeDtypeStruct((hidden_shape[1],), jnp.float32)
+            return _nbytes(jax.eval_shape(self.encode, spec, imp))
         return _nbytes(jax.eval_shape(self.encode, spec))
 
 
@@ -192,6 +198,58 @@ def _int4_per_channel() -> WireCodec:
         return unpack_int4(p["packed"]).astype(jnp.float32) * p["scale"] / 7.0
 
     return WireCodec("int4_per_channel", encode, decode, batch_invariant=False)
+
+
+def selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
+    """Token-selective mixed-precision boundary codec (BASELINE.json configs[2]).
+
+    The reference's headline scheme: the ``ratio`` least-important tokens cross
+    as symmetric int4 with one global scale over the selected slice
+    (``qwen_layer_wise.py:54-70``), the remaining tokens cross at ``high``
+    precision (fp16/bf16 is the reference's notional transfer baseline, fp32 is
+    bit-exact vs the in-place simulation). The wire carries two COMPACTED
+    buffers — ``k = floor(ratio*S)`` is static, so the low/high split has static
+    shapes — plus the token ordering needed to reassemble on the far side
+    (int32; the reference's analytic byte counts ignore this side channel, the
+    measured ``payload_bytes`` here does not).
+
+    ``encode(hidden, importance)``; the split runtime threads the importance
+    vector to importance-carrying hops.
+    """
+    if not 0.0 <= ratio <= 1.0:
+        raise ValueError(f"ratio must be in [0, 1], got {ratio}")
+    high_dtype = {"fp32": jnp.float32, "bf16": jnp.bfloat16, "fp16": jnp.float16}[high]
+
+    def encode(h, importance):
+        b, s, d = h.shape
+        k = int(ratio * s)
+        order = jnp.argsort(importance)  # ascending, stable — least important first
+        low_idx, high_idx = order[:k], order[k:]
+        low = jnp.take(h, low_idx, axis=1)  # (B, k, D)
+        max_val = jnp.max(jnp.abs(low)) if k else jnp.asarray(0.0)
+        safe = jnp.where(max_val > 0, max_val, 1.0)
+        codes = jnp.round(jnp.clip(low / safe * 7.0, -8.0, 7.0)).astype(jnp.int8)
+        return {
+            "low": pack_int4(codes) if k else jnp.zeros((b, 0, d // 2), jnp.uint8),
+            "scale": safe[None],
+            "high": jnp.take(h, high_idx, axis=1).astype(high_dtype),
+            "order": order.astype(jnp.int32),
+        }
+
+    def decode(p):
+        b = p["high"].shape[0]
+        k = p["low"].shape[1]
+        d = p["low"].shape[2] * 2 if k else p["high"].shape[2]
+        s = k + p["high"].shape[1]
+        low = unpack_int4(p["low"]).astype(jnp.float32) / 7.0 * p["scale"][0] \
+            if k else jnp.zeros((b, 0, d), jnp.float32)
+        order = p["order"]
+        out = jnp.zeros((b, s, d), jnp.float32)
+        out = out.at[:, order[:k], :].set(low)
+        return out.at[:, order[k:], :].set(p["high"].astype(jnp.float32))
+
+    return WireCodec(f"selective_int4_r{ratio}_{high}", encode, decode,
+                     batch_invariant=False, needs_importance=True)
 
 
 def get_wire_codec(name: str) -> WireCodec:

@@ -55,7 +55,9 @@ class SplitConfig:
 
     cuts: boundary layers — the activation is transferred *after* layer ``cuts[i]``
         (the reference's ``layer_of_interest`` / ``quant_layer``).
-    hop_codecs: one wire-codec name per cut (``edgellm_tpu.codecs.packing``).
+    hop_codecs: one entry per cut — either a registry name
+        (``edgellm_tpu.codecs.packing.WIRE_CODECS``) or a ``WireCodec`` instance
+        for parameterized codecs like ``selective_int4(ratio, high)``.
     """
 
     cuts: tuple
@@ -97,7 +99,9 @@ class SplitRuntime:
         self.mesh = mesh
         self.bounds = split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
-        self.codecs: list[WireCodec] = [get_wire_codec(n) for n in split.hop_codecs]
+        self.codecs: list[WireCodec] = [
+            c if isinstance(c, WireCodec) else get_wire_codec(c)
+            for c in split.hop_codecs]
         n_stages = split.n_stages
         if mesh.shape["stage"] != n_stages:
             raise ValueError(
@@ -152,7 +156,7 @@ class SplitRuntime:
         codecs = self.codecs
         mesh = self.mesh
 
-        def stage_body(local_layers, local_valid, hidden, cos, sin):
+        def stage_body(local_layers, local_valid, hidden, cos, sin, hop_imps):
             """Runs inside shard_map: one device = one pipeline stage."""
             idx = jax.lax.axis_index("stage")
             lv = {k: v[0] for k, v in local_layers.items()}  # (sz, ...)
@@ -170,7 +174,10 @@ class SplitRuntime:
                 computed, _ = jax.lax.scan(scan_body, hidden, (lv, valid))
                 hidden = jnp.where(idx == s, computed, hidden)
                 if s < n_stages - 1:
-                    payload = codecs[s].encode(hidden)
+                    if codecs[s].needs_importance:
+                        payload = codecs[s].encode(hidden, hop_imps[s])
+                    else:
+                        payload = codecs[s].encode(hidden)
                     moved = jax.tree_util.tree_map(
                         lambda a: jax.lax.ppermute(a, "stage", [(s, s + 1)]), payload)
                     hidden = jnp.where(idx == s + 1, codecs[s].decode(moved), hidden)
@@ -183,23 +190,39 @@ class SplitRuntime:
         batch_spec = P("data") if mesh.shape["data"] > 1 else P()
 
         @jax.jit
-        def fn(placed, input_ids):
+        def fn(placed, input_ids, hop_imps):
             hidden = embed(placed, input_ids)
             cos, sin = precompute_rope(cfg, input_ids.shape[1])
             lspecs = jax.tree_util.tree_map(lambda _: P("stage"), placed["layers"])
             out = shard_map(
                 stage_body,
                 mesh=mesh,
-                in_specs=(lspecs, P("stage"), batch_spec, P(), P()),
+                in_specs=(lspecs, P("stage"), batch_spec, P(), P(), P()),
                 out_specs=batch_spec,
-            )(placed["layers"], placed["layers_valid"], hidden, cos, sin)
+            )(placed["layers"], placed["layers_valid"], hidden, cos, sin, hop_imps)
             return unembed(cfg, placed, out)
 
         return fn
 
-    def forward(self, placed_params: dict, input_ids: jnp.ndarray) -> jnp.ndarray:
-        """ids -> fp32 logits, with every cut crossed as a packed ppermute."""
-        return self._forward(placed_params, input_ids)
+    def forward(self, placed_params: dict, input_ids: jnp.ndarray,
+                hop_importance: Optional[Sequence] = None) -> jnp.ndarray:
+        """ids -> fp32 logits, with every cut crossed as a packed ppermute.
+
+        ``hop_importance``: per-hop (S,) token-importance vectors, required when
+        any hop codec is token-selective (``needs_importance``); hops that don't
+        use importance may pass None entries."""
+        n_hops = len(self.codecs)
+        seq = input_ids.shape[1]
+        imps = list(hop_importance) if hop_importance is not None else [None] * n_hops
+        if len(imps) != n_hops:
+            raise ValueError(f"expected {n_hops} hop_importance entries, got {len(imps)}")
+        for c, imp in zip(self.codecs, imps):
+            if c.needs_importance and imp is None:
+                raise ValueError(f"hop codec {c.name} requires an importance vector")
+        stacked = (jnp.zeros((0, seq), jnp.float32) if not imps else
+                   jnp.stack([jnp.zeros(seq, jnp.float32) if i is None
+                              else jnp.asarray(i, jnp.float32) for i in imps]))
+        return self._forward(placed_params, input_ids, stacked)
 
     # ---------- accounting ----------
 

@@ -1,0 +1,49 @@
+"""Analysis-module tests: JS/KL formulas vs the notebook's definitions, and the
+pairwise layer-distance pipeline on a tiny model."""
+import numpy as np
+
+import jax
+
+from edgellm_tpu.models import tiny_config, init_params
+from edgellm_tpu.analysis import (
+    kl_divergence,
+    jensen_shannon_divergence,
+    layer_importance_distributions,
+    pairwise_layer_distances,
+)
+
+CFG = tiny_config("gpt_neox", num_layers=4, hidden_size=32, num_heads=4, vocab_size=128)
+
+
+def test_kl_matches_notebook_formula():
+    p = np.array([0.5, 0.5, 0.0])
+    q = np.array([0.25, 0.5, 0.25])
+    want = 0.5 * np.log2(0.5 / 0.25)  # zero-p term guarded out
+    np.testing.assert_allclose(kl_divergence(p, q), want, rtol=1e-12)
+    assert kl_divergence(p, p) == 0.0
+
+
+def test_js_symmetric_and_bounded(rng):
+    p = rng.random(16); p /= p.sum()
+    q = rng.random(16); q /= q.sum()
+    js_pq, js_qp = jensen_shannon_divergence(p, q), jensen_shannon_divergence(q, p)
+    np.testing.assert_allclose(js_pq, js_qp, rtol=1e-12)
+    assert 0.0 <= js_pq <= 1.0  # base-2 JS divergence is bounded by 1
+    assert jensen_shannon_divergence(p, p) < 1e-12
+
+
+def test_pairwise_layer_distances_pipeline(rng):
+    params = init_params(CFG, jax.random.key(3))
+    samples = [rng.integers(0, CFG.vocab_size, n) for n in (20, 28, 20)]
+    dists = layer_importance_distributions(CFG, params, samples)
+    assert len(dists) == CFG.num_layers and len(dists[0]) == 3
+    # importance distributions sum to 1 over positions (attention mass)
+    for layer in dists:
+        for d in layer:
+            np.testing.assert_allclose(d.sum(), 1.0, atol=1e-5)
+    mat = pairwise_layer_distances(dists)
+    assert mat.shape == (4, 4)
+    upper = [(i, j) for i in range(4) for j in range(i + 1, 4)]
+    assert all(np.isfinite(mat[i, j]) for i, j in upper)
+    assert all(np.isnan(mat[j, i]) for i, j in upper)
+    assert np.isnan(np.diag(mat)).all()

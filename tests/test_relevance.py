@@ -95,6 +95,85 @@ def _torch_lrp_relevance(model, ids):
     return np.stack(rel)
 
 
+def _torch_lrp_relevance_neox(model, ids):
+    """Manual torch LRP forward for GPT-NeoX: parallel residual, LayerNorm with
+    detached rsqrt(var), fused QKV head-interleaved layout, partial rotary,
+    standard-gradient GELU -> (L, H) head relevance."""
+    cfg = model.config
+    sd = {k: v.float() for k, v in model.state_dict().items()}
+    h_ = cfg.num_attention_heads
+    hd = cfg.hidden_size // h_
+    rot = int(hd * cfg.rotary_pct)
+    x = sd["gpt_neox.embed_in.weight"][ids]
+    B, S, D = x.shape
+
+    pos = torch.arange(S, dtype=torch.float32)
+    inv = 1.0 / (cfg.rotary_emb_base ** (torch.arange(0, rot, 2, dtype=torch.float32) / rot))
+    emb = torch.cat([torch.outer(pos, inv)] * 2, dim=-1)
+    cos, sin = emb.cos()[None, :, None, :], emb.sin()[None, :, None, :]
+
+    def rope(t):
+        t_rot, t_pass = t[..., :rot], t[..., rot:]
+        half = rot // 2
+        rotated = torch.cat([-t_rot[..., half:], t_rot[..., :half]], dim=-1)
+        return torch.cat([t_rot * cos + rotated * sin, t_pass], dim=-1)
+
+    def ln_lrp(v, w, b, eps):
+        mu = v.mean(-1, keepdim=True)
+        denom = torch.rsqrt(v.var(-1, keepdim=True, unbiased=False) + eps).detach()
+        return (v - mu) * denom * w + b
+
+    probs_saved = []
+    for i in range(cfg.num_hidden_layers):
+        p = f"gpt_neox.layers.{i}."
+        a_in = ln_lrp(x, sd[p + "input_layernorm.weight"],
+                      sd[p + "input_layernorm.bias"], cfg.layer_norm_eps)
+        qkv = (a_in @ sd[p + "attention.query_key_value.weight"].T
+               + sd[p + "attention.query_key_value.bias"]).view(B, S, h_, 3, hd)
+        q, k, v = rope(qkv[..., 0, :]), rope(qkv[..., 1, :]), qkv[..., 2, :]
+        scores = torch.einsum("bshd,bthd->bhst", q, k) / np.sqrt(hd)
+        mask = torch.tril(torch.ones(S, S, dtype=torch.bool))
+        scores = scores.masked_fill(~mask, torch.finfo(torch.float32).min)
+        probs = torch.softmax(scores, dim=-1)
+        probs.requires_grad_(True)
+        probs.retain_grad()
+        probs_saved.append(probs)
+        attn = torch.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, h_ * hd)
+        attn = attn @ sd[p + "attention.dense.weight"].T + sd[p + "attention.dense.bias"]
+        m_in = ln_lrp(x, sd[p + "post_attention_layernorm.weight"],
+                      sd[p + "post_attention_layernorm.bias"], cfg.layer_norm_eps)
+        mlp = torch.nn.functional.gelu(
+            m_in @ sd[p + "mlp.dense_h_to_4h.weight"].T + sd[p + "mlp.dense_h_to_4h.bias"])
+        mlp = mlp @ sd[p + "mlp.dense_4h_to_h.weight"].T + sd[p + "mlp.dense_4h_to_h.bias"]
+        x = x + attn + mlp  # parallel residual
+    post = ln_lrp(x, sd["gpt_neox.final_layer_norm.weight"],
+                  sd["gpt_neox.final_layer_norm.bias"], cfg.layer_norm_eps)
+    logits = post @ sd["embed_out.weight"].T
+    max_logits, _ = torch.max(logits[:, -1, :], dim=-1)
+    max_logits.backward(max_logits)
+    rel = [(p_ * p_.grad).sum(dim=(0, 2, 3)).detach().numpy() for p_ in probs_saved]
+    return np.stack(rel)
+
+
+def test_neox_head_relevance_matches_torch_lrp_oracle():
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    hf_cfg = GPTNeoXConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        intermediate_size=256, rotary_pct=0.25, max_position_embeddings=128,
+        hidden_act="gelu", layer_norm_eps=1e-5, use_parallel_residual=True,
+        attn_implementation="eager",
+    )
+    model = GPTNeoXForCausalLM(hf_cfg).eval()
+    cfg = config_from_hf(hf_cfg)
+    params = params_from_state_dict(cfg, model.state_dict())
+    ids = np.random.default_rng(11).integers(0, 256, size=(1, 18))
+    got = np.asarray(_chunk_relevance(cfg)(params, jnp.asarray(ids)))
+    want = _torch_lrp_relevance_neox(model, torch.tensor(ids))
+    assert got.shape == want.shape == (3, 4)
+    np.testing.assert_allclose(got, want, atol=1e-3, rtol=1e-3)
+
+
 @pytest.fixture(scope="module")
 def qwen_setup():
     hf_cfg = Qwen2Config(

@@ -1,0 +1,89 @@
+"""Selective mixed-precision wire codec (BASELINE configs[2]) tests.
+
+With high="fp32" the round-trip must EXACTLY equal the reference's in-place
+token-selective int4 simulation (same global scale over the selected slice, same
+stable-argsort selection); through the split runtime the packed payload crossing
+ppermute must reproduce the boundary_fn simulate path.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.models import tiny_config, init_params, forward
+from edgellm_tpu.codecs import int4_token_select
+from edgellm_tpu.codecs.packing import selective_int4
+from edgellm_tpu.parallel import SplitConfig, SplitRuntime, make_stage_mesh
+
+CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4, vocab_size=128)
+
+
+@pytest.fixture
+def data(rng):
+    h = jnp.asarray(rng.normal(size=(2, 16, 32)).astype(np.float32))
+    imp = jnp.asarray(rng.random(16).astype(np.float32))
+    return h, imp
+
+
+@pytest.mark.parametrize("ratio", [0.0, 0.25, 0.5, 1.0])
+def test_fp32_high_matches_simulate_exactly(data, ratio):
+    h, imp = data
+    codec = selective_int4(ratio, high="fp32")
+    got = codec.decode(codec.encode(h, imp))
+    want = int4_token_select(h, imp, ratio)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_bf16_high_bounded_error(data):
+    h, imp = data
+    codec = selective_int4(0.5, high="bf16")
+    out = codec.decode(codec.encode(h, imp))
+    # bf16 has ~3 decimal digits; unselected tokens only lose mantissa bits
+    assert float(jnp.max(jnp.abs(out - int4_token_select(h, imp, 0.5)))) < 0.05
+
+
+def test_payload_bytes_scale_with_ratio():
+    D, S = 896, 512
+    full = selective_int4(1.0, high="bf16").payload_bytes((1, S, D))
+    none = selective_int4(0.0, high="bf16").payload_bytes((1, S, D))
+    half = selective_int4(0.5, high="bf16").payload_bytes((1, S, D))
+    order_bytes = S * 4
+    assert none == S * D * 2 + order_bytes + 4 + 0  # all bf16 + order + scale
+    assert full == S * D // 2 + order_bytes + 4  # all packed int4
+    assert none > half > full
+
+
+def test_split_runtime_with_selective_hop(data):
+    params = init_params(CFG, jax.random.key(1))
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(0, CFG.vocab_size, (1, 16)))
+    imp = jnp.asarray(rng.random(16).astype(np.float32))
+    cut, ratio = 2, 0.5
+
+    rt = SplitRuntime(
+        CFG, SplitConfig(cuts=(cut,), hop_codecs=(selective_int4(ratio, "fp32"),)),
+        make_stage_mesh(2))
+    out = rt.forward(rt.place_params(params), ids, hop_importance=[imp])
+
+    def bfn(idx, h):
+        return jnp.where(idx == cut, int4_token_select(h, imp, ratio), h)
+
+    want, _ = forward(CFG, params, ids, boundary_fn=bfn)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_split_runtime_missing_importance_raises(data):
+    params = init_params(CFG, jax.random.key(1))
+    ids = jnp.zeros((1, 16), jnp.int32)
+    rt = SplitRuntime(
+        CFG, SplitConfig(cuts=(2,), hop_codecs=(selective_int4(0.5),)),
+        make_stage_mesh(2))
+    placed = rt.place_params(params)
+    with pytest.raises(ValueError, match="importance"):
+        rt.forward(placed, ids)
+
+
+def test_invalid_ratio_raises():
+    with pytest.raises(ValueError):
+        selective_int4(1.5)

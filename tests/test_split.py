@@ -127,6 +127,14 @@ def test_hop_bytes_measured(setup):
     assert b16 == D * 2
 
 
+def test_zero_cut_single_stage_runs(setup):
+    """Degenerate baseline: no cuts, one stage — still matches unsplit."""
+    params, ids, base = setup
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(), hop_codecs=()), make_stage_mesh(1))
+    out = rt.forward(rt.place_params(params), ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(base), atol=1e-5, rtol=1e-5)
+
+
 def test_mesh_stage_count_mismatch_raises(setup):
     with pytest.raises(ValueError):
         SplitRuntime(CFG, SplitConfig(cuts=(2,), hop_codecs=("fp32",)), make_stage_mesh(3))
