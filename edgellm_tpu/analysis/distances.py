@@ -54,7 +54,13 @@ def _per_layer_importance(cfg: ModelConfig):
 def layer_importance_distributions(cfg: ModelConfig, params,
                                    samples: Sequence[np.ndarray]) -> list:
     """Per-sample regular-importance distributions: list over L layers of lists
-    over samples of (S_i,) arrays (the notebook's ``all_distributions``)."""
+    over samples of (S_i,) arrays (the notebook's ``all_distributions``).
+
+    Samples run at their native lengths, like the notebook's per-line forwards —
+    each DISTINCT length compiles the stats forward once. For large ragged
+    corpora, pre-bucket or clip samples to a few fixed lengths to bound
+    compilation time.
+    """
     fn = _per_layer_importance(cfg)
     out = [[] for _ in range(cfg.num_layers)]
     for ids in samples:

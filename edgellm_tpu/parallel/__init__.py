@@ -9,5 +9,7 @@ devices as a *packed, quantized* payload via ``lax.ppermute`` inside
 CPU mesh the tests use.
 """
 from .split import SplitConfig, SplitRuntime, make_stage_mesh
+from .ring import ring_attention, forward_sp, make_seq_mesh
 
-__all__ = ["SplitConfig", "SplitRuntime", "make_stage_mesh"]
+__all__ = ["SplitConfig", "SplitRuntime", "make_stage_mesh",
+           "ring_attention", "forward_sp", "make_seq_mesh"]

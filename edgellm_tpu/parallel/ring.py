@@ -1,0 +1,171 @@
+"""Ring-attention sequence parallelism: long contexts sharded across devices.
+
+The reference handles its 299k-token corpus by clipping to 512/2048-token windows
+(``Qwen2-0.5B/main.py:151-156``) — the window *is* the context limit. Here the
+sequence axis itself shards across a ``"seq"`` mesh axis: every device holds the
+full weights and 1/n of the tokens; attention is computed blockwise with K/V
+blocks rotating around the ring via ``lax.ppermute`` (one hop per step, overlapped
+by XLA with the local matmuls), with flash-style online-softmax accumulation so
+no device ever materializes the full S x S score matrix. This is the standard
+ring-attention construction (Liu et al.; see PAPERS.md) on XLA collectives
+instead of NCCL P2P.
+
+Composability: the "seq" axis is orthogonal to the split runtime's "stage" axis —
+a config can pipeline-split the layer stack AND ring-shard the sequence.
+
+Everything is jit-safe: the ring loop is a ``lax.fori_loop`` with static block
+shapes; the causal mask is computed from global block offsets.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.configs import ModelConfig
+from ..models.transformer import (
+    apply_rotary, embed, precompute_rope, mlp, _layernorm, _rmsnorm, _norm,
+)
+
+NEG_INF = -1e30  # finite mask value: keeps exp() well-defined for empty blocks
+
+
+def make_seq_mesh(n_seq: int, devices=None) -> Mesh:
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if devices.size < n_seq:
+        raise ValueError(f"need {n_seq} devices, have {devices.size}")
+    return Mesh(devices.reshape(-1)[:n_seq], ("seq",))
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   axis_name: str = "seq") -> jnp.ndarray:
+    """Causal ring attention over locally-sharded (B, S_loc, H, hd) query blocks.
+
+    Must run inside ``shard_map`` with the sequence sharded on ``axis_name``.
+    K/V blocks circulate the ring (device i sends to i+1); after n steps every
+    query block has seen every key block once. Online softmax keeps running
+    (max, denominator, accumulator) per query — the flash-attention recurrence.
+
+    K/V may carry fewer (grouped-query) heads than Q: the unexpanded
+    (B, S_loc, KV, hd) blocks are what circulates — h/kv times less ring
+    traffic — and the head broadcast happens locally per step. The ring is
+    statically unrolled (n is a trace-time constant), so XLA can overlap each
+    hop's ppermute with the previous block's matmuls, and the last iteration
+    sends nothing.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, s_loc, h, hd = q.shape
+    rep = h // k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    q_pos = idx * s_loc + jnp.arange(s_loc)  # global positions of local queries
+
+    m = jnp.full((b, h, s_loc), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_loc), jnp.float32)
+    acc = jnp.zeros((b, h, s_loc, hd), jnp.float32)
+    k_blk, v_blk = k, v
+    ring = [(i, (i + 1) % n) for i in range(n)]
+
+    for t in range(n):
+        src = (idx - t) % n  # which global block this K/V is
+        k_pos = src * s_loc + jnp.arange(s_loc)
+        k_t = jnp.repeat(k_blk, rep, axis=2) if rep > 1 else k_blk
+        v_t = jnp.repeat(v_blk, rep, axis=2) if rep > 1 else v_blk
+        scores = jnp.einsum("bshd,bthd->bhst", q, k_t,
+                            preferred_element_type=jnp.float32) * scale
+        mask = q_pos[:, None] >= k_pos[None, :]  # global causal
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        p = jnp.exp(scores - m_new[..., None]) * mask[None, None]
+        correction = jnp.exp(m - m_new)
+        l = l * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhst,bthd->bhsd", p, v_t.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        m = m_new
+        if t < n - 1:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+            v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
+
+    out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, H, S_loc, hd)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _sp_attention(cfg: ModelConfig, lp: dict, x, cos_loc, sin_loc, axis_name):
+    """Per-layer attention with ring communication; x is (B, S_loc, D)."""
+    b, s_loc, d = x.shape
+    h, kv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    q = (x @ lp["wq"]).reshape(b, s_loc, h, hd)
+    k = (x @ lp["wk"]).reshape(b, s_loc, kv, hd)
+    v = (x @ lp["wv"]).reshape(b, s_loc, kv, hd)
+    if "bq" in lp:
+        q = q + lp["bq"].reshape(h, hd)
+        k = k + lp["bk"].reshape(kv, hd)
+        v = v + lp["bv"].reshape(kv, hd)
+    q = apply_rotary(q, cos_loc, sin_loc, cfg.rotary_dim)
+    k = apply_rotary(k, cos_loc, sin_loc, cfg.rotary_dim)
+    # GQA: the unexpanded KV-head blocks circulate the ring; ring_attention
+    # broadcasts heads locally per step
+    out = ring_attention(q, k, v, axis_name)
+    out = out.reshape(b, s_loc, h * hd) @ lp["wo"]
+    if "bo" in lp:
+        out = out + lp["bo"]
+    return out
+
+
+def _sp_block(cfg: ModelConfig, lp: dict, hidden, cos_loc, sin_loc, axis_name):
+    """Decoder block with ring attention; norms/MLP are per-token (trivially SP)."""
+    if cfg.family == "gpt_neox":
+        attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
+        attn_out = _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc, axis_name)
+        mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
+        return hidden + attn_out + mlp(cfg, lp, mlp_in)
+    attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
+    hidden = hidden + _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc, axis_name)
+    mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
+    return hidden + mlp(cfg, lp, mlp_in)
+
+
+@functools.lru_cache(maxsize=None)
+def _sp_forward(cfg: ModelConfig, mesh: Mesh, axis_name: str):
+    @jax.jit
+    def fn(params, input_ids):
+        seq = input_ids.shape[1]
+        if seq % mesh.shape[axis_name]:
+            raise ValueError(f"sequence length {seq} not divisible by "
+                             f"{axis_name} axis size {mesh.shape[axis_name]}")
+        cos, sin = precompute_rope(cfg, seq)
+
+        def body(params, ids_loc, cos_loc, sin_loc):
+            hidden = embed(params, ids_loc)  # already ring-varying via ids_loc
+
+            def scan_body(h, lp):
+                return _sp_block(cfg, lp, h, cos_loc, sin_loc, axis_name), None
+
+            hidden, _ = jax.lax.scan(scan_body, hidden, params["layers"])
+            post = _norm(cfg, hidden, params["final_norm_scale"],
+                         params.get("final_norm_bias", 0.0))
+            head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+            return jnp.einsum("bsd,dv->bsv", post, head,
+                              preferred_element_type=jnp.float32)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, axis_name), P(axis_name), P(axis_name)),
+            out_specs=P(None, axis_name),
+        )(params, input_ids, cos, sin)
+
+    return fn
+
+
+def forward_sp(cfg: ModelConfig, params, input_ids, mesh: Mesh,
+               axis_name: str = "seq") -> jnp.ndarray:
+    """Sequence-parallel forward: ids (B, S) with S sharded over ``axis_name`` ->
+    full fp32 logits. Weights replicated, activations 1/n per device, attention
+    via the K/V ring."""
+    return _sp_forward(cfg, mesh, axis_name)(params, jnp.asarray(input_ids))
