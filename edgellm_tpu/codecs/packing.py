@@ -24,32 +24,38 @@ import numpy as np
 
 
 def pack_int4(codes: jnp.ndarray) -> jnp.ndarray:
-    """Pack int4 codes in [-8, 7] (last axis even) into uint8, two per byte."""
+    """Pack int4 codes in [-8, 7] (last axis even) into uint8, two per byte.
+
+    Wire layout: element i pairs with element i + D/2 (low nibble = first half,
+    high nibble = second half). Contiguous-half pairing keeps the packing a pair
+    of full-lane slices on TPU (the interleaved 0::2/1::2 layout would be a
+    strided lane access) — the Pallas kernels share this convention.
+    """
+    half = codes.shape[-1] // 2
     u = (codes.astype(jnp.int32) + 8).astype(jnp.uint8)  # [0, 15]
-    lo, hi = u[..., 0::2], u[..., 1::2]
-    return lo | (hi << 4)
+    return u[..., :half] | (u[..., half:] << 4)
 
 
 def unpack_int4(packed: jnp.ndarray) -> jnp.ndarray:
     """Inverse of :func:`pack_int4` -> int8 codes in [-8, 7]."""
     lo = (packed & 0xF).astype(jnp.int8) - 8
     hi = (packed >> 4).astype(jnp.int8) - 8
-    out = jnp.stack([lo, hi], axis=-1)
-    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 2)
+    return jnp.concatenate([lo, hi], axis=-1)
 
 
 def pack_ternary(codes: jnp.ndarray) -> jnp.ndarray:
-    """Pack ternary codes in {-1, 0, 1} (last axis % 4 == 0) into uint8, four per byte."""
+    """Pack ternary codes in {-1, 0, 1} (last axis % 4 == 0) into uint8, four per
+    byte. Same contiguous-quarter pairing as :func:`pack_int4`."""
+    quarter = codes.shape[-1] // 4
     u = (codes.astype(jnp.int32) + 1).astype(jnp.uint8)  # [0, 2], 2 bits each
-    return (u[..., 0::4] | (u[..., 1::4] << 2) | (u[..., 2::4] << 4)
-            | (u[..., 3::4] << 6))
+    parts = [u[..., i * quarter:(i + 1) * quarter] for i in range(4)]
+    return parts[0] | (parts[1] << 2) | (parts[2] << 4) | (parts[3] << 6)
 
 
 def unpack_ternary(packed: jnp.ndarray) -> jnp.ndarray:
     """Inverse of :func:`pack_ternary` -> int8 codes in {-1, 0, 1}."""
     parts = [((packed >> (2 * i)) & 0x3).astype(jnp.int8) - 1 for i in range(4)]
-    out = jnp.stack(parts, axis=-1)
-    return out.reshape(*packed.shape[:-1], packed.shape[-1] * 4)
+    return jnp.concatenate(parts, axis=-1)
 
 
 def _nbytes(tree) -> int:

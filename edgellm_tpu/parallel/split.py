@@ -199,6 +199,10 @@ class SplitRuntime:
                 mesh=mesh,
                 in_specs=(lspecs, P("stage"), batch_spec, P(), P(), P()),
                 out_specs=batch_spec,
+                # vma tracking cannot type pallas_call outputs inside the body
+                # (hop codecs may be Pallas kernels); replication is enforced
+                # structurally by the final psum instead
+                check_vma=False,
             )(placed["layers"], placed["layers_valid"], hidden, cos, sin, hop_imps)
             return unembed(cfg, placed, out)
 
