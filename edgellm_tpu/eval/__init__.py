@@ -15,6 +15,7 @@ from .harness import (
     run_initial_sweep,
     run_channel_sweep,
 )
+from .split_eval import run_split_eval, parse_hop_codec
 
 __all__ = [
     "Chunk",
@@ -23,4 +24,6 @@ __all__ = [
     "run_token_sweep",
     "run_initial_sweep",
     "run_channel_sweep",
+    "run_split_eval",
+    "parse_hop_codec",
 ]

@@ -1,0 +1,23 @@
+"""Native (C++) host-side codec library, bound via ctypes.
+
+Compiled on first use with the system toolchain (``g++ -O2 -shared -fPIC``) and
+cached next to the source; everything degrades gracefully to the JAX/numpy
+implementations when a compiler is unavailable (``is_available()``).
+"""
+from .lib import (
+    is_available,
+    int4_per_token_encode,
+    int4_per_token_decode,
+    ternary_pack,
+    ternary_unpack,
+    int4_payload_bytes,
+)
+
+__all__ = [
+    "is_available",
+    "int4_per_token_encode",
+    "int4_per_token_decode",
+    "ternary_pack",
+    "ternary_unpack",
+    "int4_payload_bytes",
+]

@@ -1,0 +1,132 @@
+"""ctypes binding for the C++ packing library, with lazy on-demand compilation.
+
+No pybind11 in this environment (see repo constraints), so the library exposes a
+plain-C ABI and this module handles compilation (cached ``.so`` keyed by source
+mtime) and numpy array marshalling.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "packing.cc")
+_SO = os.path.join(_DIR, "_packing.so")
+_lock = threading.Lock()
+_lib = None
+_failed = False
+
+
+def _compile() -> bool:
+    # per-process temp name: concurrent builds each publish their own complete
+    # file via atomic rename instead of interleaving writes on a shared path
+    fd, tmp = tempfile.mkstemp(dir=_DIR, suffix=".so.tmp")
+    os.close(fd)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    os.replace(tmp, _SO)
+    return True
+
+
+def _load():
+    global _lib, _failed
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _compile():
+            _failed = True
+            return None
+        lib = ctypes.CDLL(_SO)
+        i64, f32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
+        u8p, i8p = ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int8)
+        lib.int4_per_token_encode.argtypes = [f32p, i64, i64, u8p, f32p]
+        lib.int4_per_token_decode.argtypes = [u8p, f32p, i64, i64, f32p]
+        lib.ternary_pack.argtypes = [i8p, i64, i64, u8p]
+        lib.ternary_unpack.argtypes = [u8p, i64, i64, i8p]
+        lib.int4_per_token_payload_bytes.argtypes = [i64, i64]
+        lib.int4_per_token_payload_bytes.restype = i64
+        _lib = lib
+        return _lib
+
+
+def is_available() -> bool:
+    """True when the native library compiled (or was cached) successfully."""
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def int4_per_token_encode(x: np.ndarray):
+    """fp32 (N, D) -> (packed (N, D/2) uint8, scales (N,) fp32), on the host."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native packing library unavailable (no g++?)")
+    x = np.ascontiguousarray(x, np.float32)
+    n, d = x.shape
+    if d % 2:
+        raise ValueError(f"int4 packing needs an even feature dim, got {d}")
+    packed = np.empty((n, d // 2), np.uint8)
+    scales = np.empty(n, np.float32)
+    lib.int4_per_token_encode(_ptr(x, ctypes.c_float), n, d,
+                              _ptr(packed, ctypes.c_uint8), _ptr(scales, ctypes.c_float))
+    return packed, scales
+
+
+def int4_per_token_decode(packed: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native packing library unavailable (no g++?)")
+    packed = np.ascontiguousarray(packed, np.uint8)
+    scales = np.ascontiguousarray(scales, np.float32)
+    n, half = packed.shape
+    out = np.empty((n, half * 2), np.float32)
+    lib.int4_per_token_decode(_ptr(packed, ctypes.c_uint8), _ptr(scales, ctypes.c_float),
+                              n, half * 2, _ptr(out, ctypes.c_float))
+    return out
+
+
+def ternary_pack(codes: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native packing library unavailable (no g++?)")
+    codes = np.ascontiguousarray(codes, np.int8)
+    n, d = codes.shape
+    if d % 4:
+        raise ValueError(f"ternary packing needs a feature dim divisible by 4, got {d}")
+    packed = np.empty((n, d // 4), np.uint8)
+    lib.ternary_pack(_ptr(codes, ctypes.c_int8), n, d, _ptr(packed, ctypes.c_uint8))
+    return packed
+
+
+def ternary_unpack(packed: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native packing library unavailable (no g++?)")
+    packed = np.ascontiguousarray(packed, np.uint8)
+    n, q = packed.shape
+    codes = np.empty((n, q * 4), np.int8)
+    lib.ternary_unpack(_ptr(packed, ctypes.c_uint8), n, q * 4, _ptr(codes, ctypes.c_int8))
+    return codes
+
+
+def int4_payload_bytes(n_tokens: int, dim: int) -> int:
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native packing library unavailable (no g++?)")
+    return int(lib.int4_per_token_payload_bytes(n_tokens, dim))

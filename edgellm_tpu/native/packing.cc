@@ -1,0 +1,92 @@
+// Host-side boundary-codec pack/unpack — the C++ twin of
+// edgellm_tpu/codecs/packing.py (contiguous-half nibble layout, contiguous-
+// quarter ternary layout).
+//
+// Role in the framework: (1) an implementation-independent oracle for the wire
+// format (the Python tests cross-check the JAX/Pallas packers against this
+// library bit-for-bit); (2) the host-side codec for boundary payloads that
+// leave the accelerator fabric (DCN / file spills), where packing on-CPU avoids
+// a device round-trip. The reference has no native code at all (SURVEY.md
+// section 2); this is framework infrastructure, not a port.
+//
+// Plain-C ABI so Python binds via ctypes (no pybind11 in this environment).
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <algorithm>
+
+extern "C" {
+
+// fp32 (n_tokens, dim) -> per-token symmetric int4: packed (n_tokens, dim/2)
+// nibbles + per-token fp32 scales. Layout: low nibble = element i, high nibble
+// = element i + dim/2.
+void int4_per_token_encode(const float* x, int64_t n_tokens, int64_t dim,
+                           uint8_t* packed, float* scales) {
+  const int64_t half = dim / 2;
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    const float* row = x + t * dim;
+    float max_abs = 0.0f;
+    for (int64_t i = 0; i < dim; ++i) max_abs = std::max(max_abs, std::fabs(row[i]));
+    const float safe = max_abs > 0.0f ? max_abs : 1.0f;
+    scales[t] = safe;
+    uint8_t* out = packed + t * half;
+    for (int64_t i = 0; i < half; ++i) {
+      const float lo_s = std::min(std::max(row[i] / safe * 7.0f, -8.0f), 7.0f);
+      const float hi_s = std::min(std::max(row[i + half] / safe * 7.0f, -8.0f), 7.0f);
+      const int lo = static_cast<int>(std::nearbyintf(lo_s)) + 8;  // [0, 15]
+      const int hi = static_cast<int>(std::nearbyintf(hi_s)) + 8;
+      out[i] = static_cast<uint8_t>((lo & 0xF) | ((hi & 0xF) << 4));
+    }
+  }
+}
+
+// Inverse: packed nibbles + scales -> fp32 (n_tokens, dim).
+void int4_per_token_decode(const uint8_t* packed, const float* scales,
+                           int64_t n_tokens, int64_t dim, float* out) {
+  const int64_t half = dim / 2;
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    const uint8_t* row = packed + t * half;
+    float* o = out + t * dim;
+    const float s = scales[t];
+    for (int64_t i = 0; i < half; ++i) {
+      o[i] = static_cast<float>((row[i] & 0xF) - 8) / 7.0f * s;
+      o[i + half] = static_cast<float>(((row[i] >> 4) & 0xF) - 8) / 7.0f * s;
+    }
+  }
+}
+
+// int8 codes in {-1,0,1} (n, dim) -> 2-bit crumbs (n, dim/4), contiguous
+// quarters, same layout as packing.pack_ternary.
+void ternary_pack(const int8_t* codes, int64_t n, int64_t dim, uint8_t* packed) {
+  const int64_t q = dim / 4;
+  for (int64_t t = 0; t < n; ++t) {
+    const int8_t* row = codes + t * dim;
+    uint8_t* out = packed + t * q;
+    for (int64_t i = 0; i < q; ++i) {
+      out[i] = static_cast<uint8_t>(
+          ((row[i] + 1) & 0x3) | (((row[i + q] + 1) & 0x3) << 2) |
+          (((row[i + 2 * q] + 1) & 0x3) << 4) | (((row[i + 3 * q] + 1) & 0x3) << 6));
+    }
+  }
+}
+
+void ternary_unpack(const uint8_t* packed, int64_t n, int64_t dim, int8_t* codes) {
+  const int64_t q = dim / 4;
+  for (int64_t t = 0; t < n; ++t) {
+    const uint8_t* row = packed + t * q;
+    int8_t* out = codes + t * dim;
+    for (int64_t i = 0; i < q; ++i) {
+      out[i] = static_cast<int8_t>((row[i] & 0x3) - 1);
+      out[i + q] = static_cast<int8_t>(((row[i] >> 2) & 0x3) - 1);
+      out[i + 2 * q] = static_cast<int8_t>(((row[i] >> 4) & 0x3) - 1);
+      out[i + 3 * q] = static_cast<int8_t>(((row[i] >> 6) & 0x3) - 1);
+    }
+  }
+}
+
+// Measured payload bytes for the int4_per_token codec (packed + fp32 scales).
+int64_t int4_per_token_payload_bytes(int64_t n_tokens, int64_t dim) {
+  return n_tokens * (dim / 2) + n_tokens * static_cast<int64_t>(sizeof(float));
+}
+
+}  // extern "C"

@@ -100,6 +100,12 @@ def main(argv=None) -> int:
     with open(args.params) as f:
         params_json = json.load(f)
 
+    def load_head_weights():
+        if not args.head_weights:
+            return None
+        with open(args.head_weights) as f:
+            return np.asarray(json.load(f))
+
     cfg, params = _load_model(args)
     corpus = _load_corpus(args, cfg.vocab_size)
     if corpus.max() >= cfg.vocab_size or corpus.min() < 0:
@@ -136,6 +142,22 @@ def main(argv=None) -> int:
 
     from .eval import run_token_sweep, run_initial_sweep, run_channel_sweep
 
+    if experiment == "split":
+        from .eval import run_split_eval
+
+        result = run_split_eval(
+            cfg, params, corpus,
+            cuts=params_json["cuts"],
+            hop_codecs=params_json["hop_codecs"],
+            max_length=max_length, stride=stride,
+            importance_method=params_json.get("importance_method"),
+            head_weights=load_head_weights(),
+            max_chunks=args.max_chunks)
+        with open(out("split_eval_results.json"), "w") as f:
+            json.dump(result, f, indent=1)
+        print(json.dumps(result))
+        return 0
+
     if experiment == "initial":
         result = run_initial_sweep(
             cfg, params, corpus, layers_of_interest=params_json["layers_of_interest"],
@@ -145,11 +167,8 @@ def main(argv=None) -> int:
             cfg, params, corpus, methods=methods,
             layers_of_interest=params_json["layers_of_interest"], **common)
     else:
-        head_weights = None
-        if args.head_weights:
-            with open(args.head_weights) as f:
-                head_weights = np.asarray(json.load(f))
-        elif "weighted_importance" in methods:
+        head_weights = load_head_weights()
+        if head_weights is None and "weighted_importance" in methods:
             raise SystemExit("weighted_importance requires --head-weights "
                              "(produce it with experiment: \"relevance\")")
         result = run_token_sweep(

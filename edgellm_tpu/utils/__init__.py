@@ -1,0 +1,4 @@
+"""Shared utilities: profiling/tracing helpers."""
+from .profiling import trace, timed, throughput
+
+__all__ = ["trace", "timed", "throughput"]

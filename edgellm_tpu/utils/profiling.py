@@ -1,0 +1,43 @@
+"""Tracing and timing helpers (SURVEY.md section 5: the reference's only
+observability is tqdm progress bars; here: real XLA traces + wall-clock helpers).
+
+``trace("/tmp/trace")`` wraps ``jax.profiler.trace`` — view the result with
+TensorBoard or Perfetto to see per-op device time, including the ``ppermute``
+boundary transfers and Pallas codec kernels. ``timed``/``throughput`` give
+honest wall-clock numbers by blocking on device completion.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture an XLA profiler trace for the enclosed block."""
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def _block(x):
+    return jax.tree_util.tree_map(
+        lambda a: a.block_until_ready() if hasattr(a, "block_until_ready") else a, x)
+
+
+def timed(fn, *args, warmup: int = 1, iters: int = 10, **kwargs):
+    """(mean seconds per call, last result); compiles/warms up first."""
+    result = None
+    for _ in range(max(warmup, 0)):
+        result = _block(fn(*args, **kwargs))
+    t0 = time.monotonic()
+    for _ in range(iters):
+        result = _block(fn(*args, **kwargs))
+    return (time.monotonic() - t0) / iters, result
+
+
+def throughput(fn, *args, tokens: int, **kwargs) -> dict:
+    """Tokens/second for a step processing ``tokens`` tokens."""
+    sec, _ = timed(fn, *args, **kwargs)
+    return {"s_per_step": sec, "tokens_per_s": tokens / sec}

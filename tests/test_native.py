@@ -1,0 +1,52 @@
+"""Native C++ packing library: bit-for-bit agreement with the JAX wire format
+(the .so acts as an implementation-independent oracle for the packed layout)."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from edgellm_tpu import native
+from edgellm_tpu.codecs.packing import get_wire_codec, pack_ternary, unpack_ternary
+
+pytestmark = pytest.mark.skipif(not native.is_available(),
+                                reason="no C++ toolchain available")
+
+
+def test_int4_encode_bitwise_matches_jax(rng):
+    x = rng.normal(size=(32, 64)).astype(np.float32)
+    packed_c, scales_c = native.int4_per_token_encode(x)
+    want = get_wire_codec("int4_per_token").encode(jnp.asarray(x[None]))
+    np.testing.assert_array_equal(packed_c, np.asarray(want["packed"][0]))
+    np.testing.assert_allclose(scales_c, np.asarray(want["scale"][0, :, 0]), rtol=1e-7)
+
+
+def test_int4_roundtrip_matches_jax(rng):
+    x = rng.normal(size=(16, 32)).astype(np.float32)
+    packed, scales = native.int4_per_token_encode(x)
+    out_c = native.int4_per_token_decode(packed, scales)
+    codec = get_wire_codec("int4_per_token")
+    want = np.asarray(codec.decode(codec.encode(jnp.asarray(x[None]))))[0]
+    np.testing.assert_allclose(out_c, want, atol=1e-6)
+
+
+def test_ternary_pack_bitwise_matches_jax(rng):
+    codes = rng.integers(-1, 2, size=(8, 32)).astype(np.int8)
+    packed_c = native.ternary_pack(codes)
+    np.testing.assert_array_equal(packed_c, np.asarray(pack_ternary(jnp.asarray(codes))))
+    np.testing.assert_array_equal(native.ternary_unpack(packed_c), codes)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_ternary(jnp.asarray(packed_c))), codes)
+
+
+def test_payload_bytes_match(rng):
+    assert native.int4_payload_bytes(512, 896) == \
+        get_wire_codec("int4_per_token").payload_bytes((1, 512, 896))
+
+
+def test_constant_and_zero_rows():
+    x = np.zeros((4, 16), np.float32)
+    x[1] = 3.25
+    packed, scales = native.int4_per_token_encode(x)
+    out = native.int4_per_token_decode(packed, scales)
+    np.testing.assert_allclose(out[0], 0.0)
+    np.testing.assert_allclose(out[1], 3.25, rtol=1e-6)

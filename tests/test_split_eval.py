@@ -1,0 +1,82 @@
+"""Split-eval driver tests: the mesh-split PPL must equal the single-device
+simulated-boundary PPL (same metric, real transport), covering the BASELINE
+config shapes on tiny models."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.models import tiny_config, init_params, forward, nll_from_logits
+from edgellm_tpu.codecs import per_token_affine_int8
+from edgellm_tpu.eval import run_split_eval, parse_hop_codec, sliding_windows
+from edgellm_tpu.codecs.packing import WireCodec
+
+CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4, vocab_size=128)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(CFG, jax.random.key(5))
+    corpus = np.random.default_rng(6).integers(0, CFG.vocab_size, 120)
+    return params, corpus
+
+
+def test_parse_hop_codec():
+    assert parse_hop_codec("int4_per_token") == "int4_per_token"
+    c = parse_hop_codec("selective_int4:0.5:fp32")
+    assert isinstance(c, WireCodec) and c.needs_importance
+    assert "0.5" in c.name and "fp32" in c.name
+
+
+def test_fp32_split_eval_matches_unsplit_ppl(setup):
+    params, corpus = setup
+    res = run_split_eval(CFG, params, corpus, cuts=[2], hop_codecs=["fp32"],
+                         max_length=48, stride=24)
+    total, n = 0.0, 0
+    for chunk in sliding_windows(corpus, 48, 24):
+        logits, _ = forward(CFG, params, jnp.asarray(chunk.input_ids))
+        total += float(nll_from_logits(logits, jnp.asarray(chunk.target_ids))) * chunk.num_loss_tokens
+        n += chunk.num_loss_tokens
+    assert res["n_tokens"] == n
+    np.testing.assert_allclose(res["ppl"], np.exp(total / n), rtol=1e-5)
+    assert res["bytes_per_token_per_hop"] == [CFG.hidden_size * 4]
+
+
+def test_int8_split_eval_matches_simulated_boundary(setup):
+    params, corpus = setup
+    res = run_split_eval(CFG, params, corpus, cuts=[2], hop_codecs=["int8_per_token"],
+                         max_length=48, stride=24)
+    total, n = 0.0, 0
+    for chunk in sliding_windows(corpus, 48, 24):
+        def bfn(idx, h):
+            return jnp.where(idx == 2, per_token_affine_int8(h), h)
+        logits, _ = forward(CFG, params, jnp.asarray(chunk.input_ids), boundary_fn=bfn)
+        total += float(nll_from_logits(logits, jnp.asarray(chunk.target_ids))) * chunk.num_loss_tokens
+        n += chunk.num_loss_tokens
+    np.testing.assert_allclose(res["ppl"], np.exp(total / n), rtol=1e-5)
+
+
+def test_selective_hop_with_importance(setup):
+    params, corpus = setup
+    res = run_split_eval(
+        CFG, params, corpus, cuts=[2],
+        hop_codecs=["selective_int4:0.5:fp32"],
+        importance_method="last_row",
+        max_length=48, stride=24)
+    assert np.isfinite(res["ppl"]) and res["chunks"] > 0
+    with pytest.raises(ValueError, match="importance_method"):
+        run_split_eval(CFG, params, corpus, cuts=[2],
+                       hop_codecs=["selective_int4:0.5:fp32"],
+                       max_length=48, stride=24)
+
+
+def test_multihop_split_eval(setup):
+    params, corpus = setup
+    res = run_split_eval(
+        CFG, params, corpus, cuts=[1, 3],
+        hop_codecs=["int8_per_token", "int4_per_token"],
+        max_length=48, stride=24)
+    assert np.isfinite(res["ppl"])
+    assert res["mesh"]["stage"] == 3
+    assert len(res["bytes_per_token_per_hop"]) == 2
