@@ -99,21 +99,29 @@ def _plain_forward(cfg: ModelConfig):
 
 @functools.lru_cache(maxsize=None)
 def _suffix_sweep(cfg: ModelConfig, layer: int, codec: str):
-    """Jitted: boundary hidden at ``layer`` -> per-ratio NLLs via one vmapped suffix.
+    """Jitted: boundary hiddens at ``layer`` -> (ratio, window) NLL matrix.
 
-    This is the reference's batched-over-ratios intent (``pythia_model.py:36-54``,
-    one batch row per ratio) done as a vmap — the batched suffix runs as one
-    executable with the ratio axis as a leading batch dimension.
+    Two nested vmaps: the reference's batched-over-ratios intent
+    (``pythia_model.py:36-54``, one batch row per ratio) plus a window-batch
+    axis, so W evaluation windows x R ratios run as ONE batched suffix
+    executable. Per-window codec scales are preserved (the reference quantizes
+    each window independently at batch 1).
+
+    boundary_hidden (W, S, D), targets (W, S), importance (W, S), ratios (R,)
+    -> (R, W).
     """
 
     @jax.jit
     def fn(params, boundary_hidden, targets, importance, ratios):
-        def one(ratio):
-            h = _apply_token_codec(codec, boundary_hidden, importance, ratio)
-            out, _ = run_layers(cfg, params, h, start=layer + 1)
-            return nll_from_logits(unembed(cfg, params, out), targets)
+        def per_ratio(ratio):
+            def per_window(h_w, tgt_w, imp_w):
+                h = _apply_token_codec(codec, h_w[None], imp_w, ratio)
+                out, _ = run_layers(cfg, params, h, start=layer + 1)
+                return nll_from_logits(unembed(cfg, params, out), tgt_w[None])
 
-        return jax.vmap(one)(ratios)
+            return jax.vmap(per_window)(boundary_hidden, targets, importance)
+
+        return jax.vmap(per_ratio)(ratios)
 
     return fn
 
@@ -210,12 +218,19 @@ def run_token_sweep(
     metrics_path: Optional[str] = None,
     max_chunks: Optional[int] = None,
     progress: Optional[Callable[[int], None]] = None,
+    window_batch: int = 1,
 ) -> SweepResult:
     """The main (method x split-layer x ratio) token-selective sweep.
 
     Reproduces ``Qwen2-0.5B/main.py:136-207`` and ``last_row_exp.py:72-143``:
     token-weighted NLL, int4 token-selective codec at the split layer, importance
     from the four attention methods. ``ratios`` are fractions (0..1).
+
+    ``window_batch``: process up to W full-length evaluation windows per forward
+    (short tail windows run singly). Identical accumulation — each window keeps
+    its own codec scales and token weighting — but one batched executable per
+    step instead of W small ones, which is what keeps the MXU busy at the
+    reference's 512-token window size.
     """
     bad = [l for l in layers_of_interest if not 0 <= int(l) < cfg.num_layers]
     if bad:
@@ -237,29 +252,49 @@ def run_token_sweep(
     stats_fn = _stats_forward(cfg)
     t0 = time.monotonic()
     next_chunk = start_chunk
+    last_ckpt = result.chunks
 
+    def process_group(group):
+        nonlocal next_chunk, last_ckpt
+        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))  # (W, S)
+        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
+        counts = np.array([c.num_loss_tokens for c in group], np.float64)
+        stats, hiddens = stats_fn(params, ids)  # hiddens (L, W, S, D)
+        for m, method in enumerate(methods):
+            imp = importance_per_layer(stats, method, hw)  # (L, W, S)
+            for l, layer in enumerate(layers_of_interest):
+                nlls = _suffix_sweep(cfg, int(layer), codec)(
+                    params, hiddens[layer], targets, imp[layer], ratios_arr)  # (R, W)
+                result.total_nll[m, l] += np.asarray(nlls, np.float64) @ counts
+        result.n_tokens += counts.sum()
+        result.chunks += len(group)
+        next_chunk = group[-1].index + 1
+        if progress:
+            progress(group[-1].index)
+        if result.chunks - last_ckpt >= checkpoint_every:
+            last_ckpt = result.chunks
+            _save_checkpoint(checkpoint_path, result, next_chunk)
+            _emit(metrics_path, {"chunk": group[-1].index, "n_tokens": result.n_tokens,
+                                 "ppl": result.ppl().tolist()})
+
+    buffer = []
     for chunk in sliding_windows(token_ids, max_length, stride):
         if chunk.index < start_chunk:
             continue
-        if max_chunks is not None and result.chunks >= max_chunks:
+        if max_chunks is not None and result.chunks + len(buffer) >= max_chunks:
             break
-        ids, targets = jnp.asarray(chunk.input_ids), jnp.asarray(chunk.target_ids)
-        stats, hiddens = stats_fn(params, ids)
-        next_chunk = chunk.index + 1
-        for m, method in enumerate(methods):
-            imp = importance_per_layer(stats, method, hw)  # (L, B, S)
-            for l, layer in enumerate(layers_of_interest):
-                nlls = _suffix_sweep(cfg, int(layer), codec)(
-                    params, hiddens[layer], targets, imp[layer, 0], ratios_arr)
-                result.total_nll[m, l] += np.asarray(nlls) * chunk.num_loss_tokens
-        result.n_tokens += chunk.num_loss_tokens
-        result.chunks += 1
-        if progress:
-            progress(chunk.index)
-        if result.chunks % checkpoint_every == 0:
-            _save_checkpoint(checkpoint_path, result, chunk.index + 1)
-            _emit(metrics_path, {"chunk": chunk.index, "n_tokens": result.n_tokens,
-                                 "ppl": result.ppl().tolist()})
+        if chunk.input_ids.shape[1] == max_length and window_batch > 1:
+            buffer.append(chunk)
+            if len(buffer) == window_batch:
+                process_group(buffer)
+                buffer = []
+        else:
+            if buffer:
+                process_group(buffer)
+                buffer = []
+            process_group([chunk])
+    if buffer:
+        process_group(buffer)
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,
@@ -335,8 +370,8 @@ def run_initial_sweep(
             else:
                 imp, codec = reg[int(spec), 0], "affine_int8_rank"
             nlls = _suffix_sweep(cfg, quant_layer, codec)(
-                params, hiddens[quant_layer], targets, imp, fracs)
-            result.total_nll[l] += np.asarray(nlls)
+                params, hiddens[quant_layer], targets, imp[None], fracs)  # (R, 1)
+            result.total_nll[l] += np.asarray(nlls)[:, 0]
         result.n_tokens += chunk.num_loss_tokens
         result.chunks += 1
         if result.chunks % checkpoint_every == 0:

@@ -150,6 +150,19 @@ def test_initial_sweep_runs_all_ordering_variants(setup):
     assert not np.isclose(res.total_nll[0, 2], res.total_nll[0, 0], rtol=0, atol=1e-7)
 
 
+def test_window_batching_is_exact(setup):
+    """window_batch > 1 changes the executable, not the math: identical totals,
+    including the short tail window that runs singly."""
+    params, corpus = setup
+    kw = dict(methods=["regular_importance", "last_row"], layers_of_interest=[1, 3],
+              ratios=[0.0, 0.5, 1.0], max_length=48, stride=24)
+    single = run_token_sweep(CFG, params, corpus, **kw)
+    batched = run_token_sweep(CFG, params, corpus, window_batch=3, **kw)
+    assert batched.chunks == single.chunks
+    assert batched.n_tokens == single.n_tokens
+    np.testing.assert_allclose(batched.total_nll, single.total_nll, rtol=1e-5, atol=1e-5)
+
+
 def test_metrics_jsonl_written(setup, tmp_path):
     params, corpus = setup
     mpath = str(tmp_path / "metrics.jsonl")
