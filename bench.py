@@ -6,13 +6,18 @@ importance scoring for 4 methods from a full attention pass, then
 4 methods x 1 split layer x 5 ratios quantized evaluations. The reference runs
 1 eager + 20 quantized FULL forwards per chunk at ~16.0 s/chunk on its Colab GPU
 (``Notebooks/qwen2-0.5B_experiment.ipynb`` cell 12, BASELINE.md). Here the same
-sweep is one stats forward + vmapped layer suffixes.
+sweep is one stats forward + window-batched vmapped layer suffixes with the
+full-vocab unembed restricted to the scored tail positions.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-vs_baseline > 1 means faster than the reference's s/chunk on its hardware.
+vs_baseline > 1 means faster than the reference's s/chunk on its hardware,
+plus observability fields: tokens_per_s (scored tokens), model_tflops_per_s and
+mfu (analytic sweep FLOPs vs the chip's assumed bf16 peak).
 
-Env knobs: BENCH_CHUNKS (default 8), BENCH_DTYPE (float32|bfloat16, default
-bfloat16 — TPU MXU native; fp32 PPL parity is the CPU test suite's job).
+Env knobs: BENCH_CHUNKS (default 96), BENCH_WINDOW_BATCH (default 32 — batches
+evaluation windows into one executable to feed the MXU), BENCH_DTYPE
+(float32|bfloat16, default bfloat16), BENCH_PEAK_TFLOPS (assumed bf16 peak for
+the MFU denominator, default 197 = TPU v5e).
 """
 import json
 import os
@@ -28,38 +33,59 @@ def main():
     import jax.numpy as jnp
     from edgellm_tpu.models import QWEN2_0_5B as cfg, init_params
     from edgellm_tpu.eval import run_token_sweep
+    from edgellm_tpu.utils.flops import token_sweep_flops_per_chunk
 
-    n_chunks = int(os.environ.get("BENCH_CHUNKS", "8"))
+    n_chunks = int(os.environ.get("BENCH_CHUNKS", "96"))
+    window_batch = int(os.environ.get("BENCH_WINDOW_BATCH", "32"))
+    peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
         os.environ.get("BENCH_DTYPE", "bfloat16")]
+
+    max_length, stride = 512, 32
+    methods = ["regular_importance", "weighted_importance", "last_row", "aggregate_till"]
+    layers_of_interest = [11]
+    ratios = [0.0, 0.25, 0.5, 0.75, 1.0]
 
     params = init_params(cfg, jax.random.key(0), dtype=dtype)
     rng = np.random.default_rng(0)
     # corpus long enough for n_chunks full 512-token windows at stride 32 + warmup
-    corpus = rng.integers(0, cfg.vocab_size, 512 + 32 * (n_chunks + 2))
+    corpus = rng.integers(0, cfg.vocab_size, max_length + stride * (n_chunks + 2))
     head_weights = rng.random((cfg.num_layers, cfg.num_heads)).astype(np.float32)
     head_weights /= head_weights.sum(axis=1, keepdims=True)
 
     kw = dict(
-        methods=["regular_importance", "weighted_importance", "last_row", "aggregate_till"],
-        layers_of_interest=[11],
-        ratios=[0.0, 0.25, 0.5, 0.75, 1.0],
-        max_length=512, stride=32, head_weights=head_weights,
+        methods=methods, layers_of_interest=layers_of_interest, ratios=ratios,
+        max_length=max_length, stride=stride, head_weights=head_weights,
+        window_batch=window_batch,
     )
 
-    # warmup: compile both chunk shapes out of band
-    run_token_sweep(cfg, params, corpus, max_chunks=1, **kw)
+    # warmup: one full untimed pass over the same chunk schedule, so every
+    # executable the timed run needs (chunk-0 group, steady groups, the final
+    # partial group) is compiled and cached before the clock starts
+    run_token_sweep(cfg, params, corpus, max_chunks=n_chunks, **kw)
 
     t0 = time.monotonic()
     result = run_token_sweep(cfg, params, corpus, max_chunks=n_chunks, **kw)
     elapsed = time.monotonic() - t0
     s_per_chunk = elapsed / result.chunks
 
+    # analytic FLOPs for a steady-state chunk (stride-token scoring tail)
+    chunk_flops = token_sweep_flops_per_chunk(
+        cfg, max_length, tail=stride, n_methods=len(methods),
+        layers_of_interest=layers_of_interest, n_ratios=len(ratios))
+    tflops_per_s = chunk_flops / s_per_chunk / 1e12
+
     print(json.dumps({
         "metric": "qwen2-0.5b sweep time per 32-token chunk (4 methods x 1 layer x 5 ratios)",
         "value": round(s_per_chunk, 4),
         "unit": "s/chunk",
         "vs_baseline": round(REFERENCE_S_PER_CHUNK / s_per_chunk, 2),
+        "tokens_per_s": round(stride / s_per_chunk, 1),
+        "window_batch": window_batch,
+        "model_tflops_per_chunk": round(chunk_flops / 1e12, 3),
+        "model_tflops_per_s": round(tflops_per_s, 2),
+        "mfu": round(tflops_per_s / peak_tflops, 4),
+        "assumed_peak_tflops": peak_tflops,
     }))
 
 

@@ -108,7 +108,10 @@ def _int8_per_token() -> WireCodec:
     def encode(h):
         mn = jnp.min(h, axis=-1, keepdims=True)
         mx = jnp.max(h, axis=-1, keepdims=True)
-        scale = (mx - mn) / 255.0
+        # multiply by the fp32 reciprocal rather than divide: a constant divide
+        # is strength-reduced differently under jit vs eager (1-ulp drift), and
+        # the Pallas twin must produce bit-identical scales
+        scale = (mx - mn) * jnp.float32(1.0 / 255.0)
         safe = jnp.where(scale > 0, scale, 1.0)
         zp = jnp.round(-128.0 - mn / safe)
         q = jnp.clip(jnp.round(h / safe) + zp, -128, 127).astype(jnp.int8)
@@ -206,7 +209,19 @@ def _int4_per_channel() -> WireCodec:
     return WireCodec("int4_per_channel", encode, decode, batch_invariant=False)
 
 
-def selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
+def _jnp_quant_pack(low: jnp.ndarray, safe: jnp.ndarray) -> jnp.ndarray:
+    """(B, k, D) fp32 + global scale -> packed (B, k, D/2) int4 nibbles."""
+    codes = jnp.round(jnp.clip(low / safe * 7.0, -8.0, 7.0)).astype(jnp.int8)
+    return pack_int4(codes)
+
+
+def _jnp_unpack_dequant(packed: jnp.ndarray, safe: jnp.ndarray) -> jnp.ndarray:
+    return unpack_int4(packed).astype(jnp.float32) / 7.0 * safe
+
+
+def selective_int4(ratio: float, high: str = "bf16", *,
+                   quant_pack=None, unpack_dequant=None,
+                   name_suffix: str = "") -> WireCodec:
     """Token-selective mixed-precision boundary codec (BASELINE.json configs[2]).
 
     The reference's headline scheme: the ``ratio`` least-important tokens cross
@@ -221,10 +236,16 @@ def selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
 
     ``encode(hidden, importance)``; the split runtime threads the importance
     vector to importance-carrying hops.
+
+    ``quant_pack(low, scale)`` / ``unpack_dequant(packed, scale)`` override the
+    int4 compute core (the Pallas wrapper passes its fused kernels; the wire
+    format and all selection/reassembly logic stay in this one definition).
     """
     if not 0.0 <= ratio <= 1.0:
         raise ValueError(f"ratio must be in [0, 1], got {ratio}")
     high_dtype = {"fp32": jnp.float32, "bf16": jnp.bfloat16, "fp16": jnp.float16}[high]
+    quant_pack = quant_pack or _jnp_quant_pack
+    unpack_dequant = unpack_dequant or _jnp_unpack_dequant
 
     def encode(h, importance):
         b, s, d = h.shape
@@ -234,9 +255,8 @@ def selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
         low = jnp.take(h, low_idx, axis=1)  # (B, k, D)
         max_val = jnp.max(jnp.abs(low)) if k else jnp.asarray(0.0)
         safe = jnp.where(max_val > 0, max_val, 1.0)
-        codes = jnp.round(jnp.clip(low / safe * 7.0, -8.0, 7.0)).astype(jnp.int8)
         return {
-            "low": pack_int4(codes) if k else jnp.zeros((b, 0, d // 2), jnp.uint8),
+            "low": quant_pack(low, safe) if k else jnp.zeros((b, 0, d // 2), jnp.uint8),
             "scale": safe[None],
             "high": jnp.take(h, high_idx, axis=1).astype(high_dtype),
             "order": order.astype(jnp.int32),
@@ -247,20 +267,34 @@ def selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
         k = p["low"].shape[1]
         d = p["low"].shape[2] * 2 if k else p["high"].shape[2]
         s = k + p["high"].shape[1]
-        low = unpack_int4(p["low"]).astype(jnp.float32) / 7.0 * p["scale"][0] \
+        low = unpack_dequant(p["low"], p["scale"][0]) \
             if k else jnp.zeros((b, 0, d), jnp.float32)
         order = p["order"]
         out = jnp.zeros((b, s, d), jnp.float32)
         out = out.at[:, order[:k], :].set(low)
         return out.at[:, order[k:], :].set(p["high"].astype(jnp.float32))
 
-    return WireCodec(f"selective_int4_r{ratio}_{high}", encode, decode,
+    return WireCodec(f"selective_int4_r{ratio}_{high}{name_suffix}", encode, decode,
                      batch_invariant=False, needs_importance=True)
+
+
+def _pallas(base_name: str) -> Callable[[], WireCodec]:
+    """Lazy factory for a Pallas-backed codec (pallas_kernels imports this
+    module, so the import must happen at call time)."""
+
+    def factory() -> WireCodec:
+        from .pallas_kernels import pallas_variant
+
+        return pallas_variant(get_wire_codec(base_name))
+
+    return factory
 
 
 def get_wire_codec(name: str) -> WireCodec:
     """Codec registry. Names map to the reference's boundary compression schemes
-    (fp16 is its notional uncompressed transfer baseline, BASELINE.md)."""
+    (fp16 is its notional uncompressed transfer baseline, BASELINE.md). The
+    ``*_pallas`` names select the fused TPU kernel implementation explicitly;
+    on TPU the split runtime substitutes them for the jnp twins automatically."""
     factories = {
         "fp32": lambda: _identity_codec("fp32", jnp.float32),
         "bf16": lambda: _identity_codec("bf16", jnp.bfloat16),
@@ -272,6 +306,10 @@ def get_wire_codec(name: str) -> WireCodec:
         "int4_per_channel": _int4_per_channel,
         "ternary_mean": lambda: _ternary("mean"),
         "ternary_max": lambda: _ternary("max"),
+        "int4_per_token_pallas": _pallas("int4_per_token"),
+        "int8_per_token_pallas": _pallas("int8_per_token"),
+        "ternary_mean_pallas": _pallas("ternary_mean"),
+        "ternary_max_pallas": _pallas("ternary_max"),
     }
     if name not in factories:
         raise ValueError(f"unknown wire codec {name!r}; options: {sorted(factories)}")
@@ -280,4 +318,6 @@ def get_wire_codec(name: str) -> WireCodec:
 
 WIRE_CODECS = ("fp32", "bf16", "fp16", "int8_per_token", "int8_per_channel",
                "int4_global", "int4_per_token", "int4_per_channel",
-               "ternary_mean", "ternary_max")
+               "ternary_mean", "ternary_max",
+               "int4_per_token_pallas", "int8_per_token_pallas",
+               "ternary_mean_pallas", "ternary_max_pallas")

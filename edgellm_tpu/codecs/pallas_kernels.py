@@ -2,8 +2,8 @@
 
 The reference's clearest kernel-shaped code is its per-channel Python loop over
 896 channels (``qwen_layer_wise.py:125-152``, SURVEY.md section 3.5); here the
-codec ops are single fused TPU kernels: quantize + nibble-pack in one VMEM pass
-(fp32 in -> packed uint8 + scales out, one HBM round-trip instead of
+codec ops are single fused TPU kernels: quantize + nibble/crumb-pack in one VMEM
+pass (fp32 in -> packed uint8 + scales out, one HBM round-trip instead of
 quantize/clip/round/pack each materializing an intermediate), and the matching
 unpack + dequantize.
 
@@ -15,20 +15,31 @@ Layout notes (see ``pallas_guide.md``):
 - interpret mode runs the same kernels on CPU (used by the test suite; the
   wrappers auto-select based on the backend).
 
-These kernels implement the ``int4_per_token`` wire codec; ``pallas_wire_codec``
-wraps them in the :class:`~edgellm_tpu.codecs.packing.WireCodec` interface so the
-split runtime can use them as hop codecs on TPU unchanged.
+Kernel inventory (each bit-identical to its jnp twin in ``packing`` — tested):
+- ``int4_per_token``: per-row max-abs scale + quantize + pack, fully fused;
+- ``int8_per_token``: per-row affine (min/max -> scale, zero-point) + quantize;
+- scalar-scale int4 quantize+pack — the compute core of ``selective_int4``
+  (the gather/scatter of selected tokens stays in XLA, which lowers it to
+  efficient dynamic-slice sequences; the FLOP+pack part is the kernel);
+- channel-scale ternary quantize+pack (``ternary_mean`` / ``ternary_max``;
+  the (B,S) channel-scale reduction stays in XLA).
+
+``pallas_wire_codec`` / ``pallas_int8_per_token`` / ``pallas_selective_int4`` /
+``pallas_ternary`` wrap these in the
+:class:`~edgellm_tpu.codecs.packing.WireCodec` interface; ``pallas_variant``
+maps any jnp wire codec to its Pallas twin (the split runtime substitutes
+automatically on TPU).
 """
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
-from .packing import WireCodec
+from .packing import WireCodec, selective_int4
 
 
 def _use_interpret() -> bool:
@@ -109,6 +120,196 @@ def int4_decode_pallas(packed: jnp.ndarray, scale: jnp.ndarray,
     )(packed, scale)
 
 
+def _int8_affine_encode_kernel(x_ref, q_ref, scale_ref, mn_ref):
+    """Per-row affine int8: scale = (max-min)/255, zero-point from min."""
+    x = x_ref[:]  # (T, D) fp32
+    mn = jnp.min(x, axis=-1, keepdims=True)
+    mx = jnp.max(x, axis=-1, keepdims=True)
+    scale = (mx - mn) * jnp.float32(1.0 / 255.0)  # matches packing.py bit-for-bit
+    safe = jnp.where(scale > 0, scale, 1.0)
+    zp = jnp.round(-128.0 - mn / safe)
+    q_ref[:] = jnp.clip(jnp.round(x / safe) + zp, -128, 127).astype(jnp.int8)
+    scale_ref[:] = scale
+    mn_ref[:] = mn
+
+
+def _int8_affine_decode_kernel(q_ref, scale_ref, mn_ref, out_ref):
+    scale, mn = scale_ref[:], mn_ref[:]
+    safe = jnp.where(scale > 0, scale, 1.0)
+    zp = jnp.round(-128.0 - mn / safe)
+    deq = (q_ref[:].astype(jnp.float32) - zp) * safe
+    out_ref[:] = jnp.where(scale > 0, deq, mn)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_affine_encode_pallas(x: jnp.ndarray, interpret: bool | None = None):
+    """(N, D) fp32 -> (q (N, D) int8, scale (N, 1) fp32, mn (N, 1) fp32)."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = x.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _int8_affine_encode_kernel,
+        grid=(n // t,),
+        in_specs=[pl.BlockSpec((t, d), lambda i: (i, 0))],
+        out_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((t, 1), lambda i: (i, 0)),
+            pl.BlockSpec((t, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, d), jnp.int8),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x.astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_affine_decode_pallas(q: jnp.ndarray, scale: jnp.ndarray, mn: jnp.ndarray,
+                              interpret: bool | None = None) -> jnp.ndarray:
+    """Inverse of :func:`int8_affine_encode_pallas` -> (N, D) fp32."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = q.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _int8_affine_decode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((t, 1), lambda i: (i, 0)),
+            pl.BlockSpec((t, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), jnp.float32),
+        interpret=interpret,
+    )(q, scale, mn)
+
+
+def _int4_scaled_encode_kernel(x_ref, scale_ref, packed_ref):
+    """int4 quantize + pack with a provided broadcast scale (scalar block)."""
+    x = x_ref[:]
+    half = x.shape[-1] // 2
+    safe = scale_ref[0, 0]
+    codes = jnp.round(jnp.clip(x / safe * 7.0, -8.0, 7.0)).astype(jnp.int32) + 8
+    packed_ref[:] = (codes[:, :half] | (codes[:, half:] << 4)).astype(jnp.uint8)
+
+
+def _int4_scaled_decode_kernel(packed_ref, scale_ref, out_ref):
+    packed = packed_ref[:].astype(jnp.int32)
+    lo = (packed & 0xF) - 8
+    hi = ((packed >> 4) & 0xF) - 8
+    codes = jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+    out_ref[:] = codes / 7.0 * scale_ref[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int4_scaled_encode_pallas(x: jnp.ndarray, scale: jnp.ndarray,
+                              interpret: bool | None = None) -> jnp.ndarray:
+    """(N, D) fp32 + global scale (1, 1) -> packed (N, D/2) uint8."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = x.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _int4_scaled_encode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, d // 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d // 2), jnp.uint8),
+        interpret=interpret,
+    )(x.astype(jnp.float32), scale.reshape(1, 1).astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int4_scaled_decode_pallas(packed: jnp.ndarray, scale: jnp.ndarray,
+                              interpret: bool | None = None) -> jnp.ndarray:
+    """Inverse of :func:`int4_scaled_encode_pallas` -> (N, D) fp32."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, dh = packed.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _int4_scaled_decode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, dh), lambda i: (i, 0)),
+            pl.BlockSpec((1, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, dh * 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, dh * 2), jnp.float32),
+        interpret=interpret,
+    )(packed, scale.reshape(1, 1).astype(jnp.float32))
+
+
+def _ternary_encode_kernel(x_ref, scale_ref, packed_ref):
+    """Ternary quantize + 2-bit pack with provided per-channel scales (1, D)."""
+    x = x_ref[:]
+    quarter = x.shape[-1] // 4
+    codes = (jnp.clip(jnp.round(x / scale_ref[:]), -1, 1).astype(jnp.int32) + 1)
+    packed_ref[:] = (codes[:, :quarter]
+                     | (codes[:, quarter:2 * quarter] << 2)
+                     | (codes[:, 2 * quarter:3 * quarter] << 4)
+                     | (codes[:, 3 * quarter:] << 6)).astype(jnp.uint8)
+
+
+def _ternary_decode_kernel(packed_ref, scale_ref, out_ref):
+    packed = packed_ref[:].astype(jnp.int32)
+    parts = [((packed >> (2 * i)) & 0x3) - 1 for i in range(4)]
+    codes = jnp.concatenate(parts, axis=-1).astype(jnp.float32)
+    out_ref[:] = codes * scale_ref[:]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ternary_encode_pallas(x: jnp.ndarray, scale: jnp.ndarray,
+                          interpret: bool | None = None) -> jnp.ndarray:
+    """(N, D) fp32 + channel scales (1, D) -> packed (N, D/4) uint8."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = x.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _ternary_encode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, d // 4), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d // 4), jnp.uint8),
+        interpret=interpret,
+    )(x.astype(jnp.float32), scale.reshape(1, -1).astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ternary_decode_pallas(packed: jnp.ndarray, scale: jnp.ndarray,
+                          interpret: bool | None = None) -> jnp.ndarray:
+    """Inverse of :func:`ternary_encode_pallas` -> (N, D) fp32."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, dq = packed.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _ternary_decode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, dq), lambda i: (i, 0)),
+            pl.BlockSpec((1, dq * 4), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, dq * 4), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, dq * 4), jnp.float32),
+        interpret=interpret,
+    )(packed, scale.reshape(1, -1).astype(jnp.float32))
+
+
+# ---------- WireCodec wrappers ----------
+
+
 def pallas_wire_codec() -> WireCodec:
     """``int4_per_token`` wire codec backed by the fused Pallas kernels.
 
@@ -129,3 +330,96 @@ def pallas_wire_codec() -> WireCodec:
         return out.reshape(b, s, dh * 2)
 
     return WireCodec("int4_per_token_pallas", encode, decode)
+
+
+def pallas_int8_per_token() -> WireCodec:
+    """``int8_per_token`` wire codec backed by the fused affine kernels."""
+
+    def encode(h):
+        b, s, d = h.shape
+        q, scale, mn = int8_affine_encode_pallas(h.reshape(b * s, d))
+        return {"q": q.reshape(b, s, d), "scale": scale.reshape(b, s, 1),
+                "mn": mn.reshape(b, s, 1)}
+
+    def decode(p):
+        b, s, d = p["q"].shape
+        out = int8_affine_decode_pallas(p["q"].reshape(b * s, d),
+                                        p["scale"].reshape(b * s, 1),
+                                        p["mn"].reshape(b * s, 1))
+        return out.reshape(b, s, d)
+
+    return WireCodec("int8_per_token_pallas", encode, decode)
+
+
+def pallas_ternary(kind: str) -> WireCodec:
+    """``ternary_mean`` / ``ternary_max`` with the quantize+pack fused; the
+    (batch, seq) channel-scale reduction stays in XLA (a single fused reduce)."""
+
+    def encode(h):
+        b, s, d = h.shape
+        if kind == "mean":
+            scale = jnp.mean(h, axis=(0, 1), keepdims=True) + 1e-8
+        else:
+            cmax = jnp.max(jnp.abs(h), axis=(0, 1), keepdims=True)
+            scale = jnp.where(cmax > 0, cmax, 1.0)
+        packed = ternary_encode_pallas(h.reshape(b * s, d), scale.reshape(1, d))
+        return {"packed": packed.reshape(b, s, d // 4), "scale": scale}
+
+    def decode(p):
+        b, s, dq = p["packed"].shape
+        out = ternary_decode_pallas(p["packed"].reshape(b * s, dq),
+                                    p["scale"].reshape(1, dq * 4))
+        return out.reshape(b, s, dq * 4)
+
+    return WireCodec(f"ternary_{kind}_pallas", encode, decode,
+                     batch_invariant=False)
+
+
+def pallas_selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
+    """Token-selective mixed-precision codec with the int4 low-path quantize+pack
+    (and unpack+dequantize) as fused kernels.
+
+    One definition of the wire format: this delegates to
+    ``packing.selective_int4`` with the compute core swapped for the kernels —
+    the gather of the k least-important tokens and the global max-abs reduction
+    stay in XLA (gathers are XLA's strength; a Pallas row-gather would serialize
+    on dynamic sublane indices), the quantize+pack of the gathered (B, k, D)
+    slice is the kernel.
+    """
+
+    def quant_pack(low, safe):
+        b, k, d = low.shape
+        return int4_scaled_encode_pallas(low.reshape(b * k, d), safe) \
+            .reshape(b, k, d // 2)
+
+    def unpack_dequant(packed, safe):
+        b, k, dh = packed.shape
+        return int4_scaled_decode_pallas(packed.reshape(b * k, dh), safe) \
+            .reshape(b, k, dh * 2)
+
+    return selective_int4(ratio, high, quant_pack=quant_pack,
+                          unpack_dequant=unpack_dequant, name_suffix="_pallas")
+
+
+_PALLAS_FACTORIES = {
+    "int4_per_token": pallas_wire_codec,
+    "int8_per_token": pallas_int8_per_token,
+    "ternary_mean": lambda: pallas_ternary("mean"),
+    "ternary_max": lambda: pallas_ternary("max"),
+}
+
+
+def pallas_variant(codec: WireCodec) -> Optional[WireCodec]:
+    """The Pallas-backed twin of a jnp wire codec, or None when no fused kernel
+    exists (identity casts, per-channel int codecs — pure XLA is already one
+    fused op for those). The split runtime uses this to substitute kernels on
+    TPU automatically."""
+    if codec.name.endswith("_pallas"):
+        return codec
+    if codec.name in _PALLAS_FACTORIES:
+        return _PALLAS_FACTORIES[codec.name]()
+    if codec.name.startswith("selective_int4_r"):
+        ratio_high = codec.name[len("selective_int4_r"):]
+        ratio_str, high = ratio_high.rsplit("_", 1)
+        return pallas_selective_int4(float(ratio_str), high)
+    return None

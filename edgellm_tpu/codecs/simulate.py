@@ -29,18 +29,31 @@ import jax.numpy as jnp
 CHANNEL_METHODS = ("channel_8", "channel_4", "channel_1_mean", "channel_1_max")
 
 
-def token_select_mask(importance: jnp.ndarray, ratio, seq_len: int) -> jnp.ndarray:
+def token_select_mask(importance: jnp.ndarray, ratio, seq_len: int,
+                      k=None) -> jnp.ndarray:
     """Boolean mask (S,) marking the ``int(ratio * seq_len)`` least-important tokens.
 
     Matches ``argsort(importance, descending=False)[:int(ratio*S)]``
     (``qwen_layer_wise.py:57``): ascending stable argsort, take the first k.
     jit-safe version: rank every position by importance (stable, so ties break by
     position exactly like torch's stable sort) and mark ranks < k.
+
+    ``k``: the token count, when the caller has already computed it. Pass
+    ``int(ratio * seq_len)`` evaluated in Python float64 whenever ``ratio`` is
+    known host-side — the reference truncates the float64 product
+    (``qwen_layer_wise.py:57``), and for near-integer products (e.g. 0.3 * 10)
+    float64 truncation and the float32 traced fallback below disagree by one
+    token. The wire codec (``packing.selective_int4``) computes k the float64
+    way, so host-side k keeps simulate-vs-wire parity bit-exact.
     """
     order = jnp.argsort(importance)  # ascending, stable
     rank = jnp.argsort(order)  # rank[i] = position of token i in ascending order
-    k = jnp.floor(ratio * seq_len).astype(jnp.int32)
-    return rank < k
+    if k is None:
+        if isinstance(ratio, (int, float)):
+            k = int(float(ratio) * seq_len)
+        else:
+            k = jnp.floor(ratio * seq_len).astype(jnp.int32)  # traced fallback
+    return rank < jnp.asarray(k, jnp.int32)
 
 
 def top_rho_mask(distribution: jnp.ndarray, threshold) -> jnp.ndarray:
@@ -78,9 +91,13 @@ def _masked_symmetric(hidden: jnp.ndarray, mask: jnp.ndarray, bits: int) -> jnp.
     return jnp.where(m, deq, hidden)
 
 
-def int4_token_select(hidden: jnp.ndarray, importance: jnp.ndarray, ratio) -> jnp.ndarray:
-    """The reference's headline codec: symmetric int4 on the least-important tokens."""
-    mask = token_select_mask(importance, ratio, hidden.shape[1])
+def int4_token_select(hidden: jnp.ndarray, importance: jnp.ndarray, ratio,
+                      k=None) -> jnp.ndarray:
+    """The reference's headline codec: symmetric int4 on the least-important tokens.
+
+    ``k`` (optional): host-computed ``int(ratio * S)`` — see
+    :func:`token_select_mask` for why float64 truncation matters."""
+    mask = token_select_mask(importance, ratio, hidden.shape[1], k=k)
     return _masked_symmetric(hidden, mask, bits=4)
 
 
@@ -99,7 +116,8 @@ def per_token_affine_int8(hidden: jnp.ndarray, mask: jnp.ndarray | None = None) 
     """
     mn = jnp.min(hidden, axis=-1, keepdims=True)
     mx = jnp.max(hidden, axis=-1, keepdims=True)
-    scale = (mx - mn) / 255.0
+    # reciprocal multiply, matching the wire codec bit-for-bit (packing.py)
+    scale = (mx - mn) * jnp.float32(1.0 / 255.0)
     safe_scale = jnp.where(scale > 0, scale, 1.0)
     zp = jnp.round(-128.0 - mn / safe_scale)
     q = jnp.clip(jnp.round(hidden / safe_scale) + zp, -128, 127)

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from ..models import run_layers, unembed, nll_from_logits
-from ..models.transformer import run_layers_from_ids
+from ..models.transformer import run_layers_from_ids, nll_tail
 from ..models.configs import ModelConfig
 from ..codecs import (
     int4_token_select,
@@ -49,18 +49,20 @@ from .windowing import sliding_windows
 TOKEN_CODECS = ("int4_token_select", "affine_int8_rank", "affine_int8_top_rho")
 
 
-def _apply_token_codec(codec: str, hidden, importance, ratio):
+def _apply_token_codec(codec: str, hidden, importance, ratio, k):
     """Quantize ``hidden`` (B, S, D) at the boundary under one token codec.
 
     ``ratio`` is always a *fraction* here; "initial"-style integer ratios are
     normalized by the driver (the reference multiplies by 0.1 at use sites:
-    ``pythia_model.py:95,142``).
+    ``pythia_model.py:95,142``). ``k`` is the host-computed ``int(ratio * S)``
+    token count for the rank-based codecs (float64 truncation, matching the
+    reference and the wire codecs — see ``token_select_mask``).
     """
     seq_len = hidden.shape[1]
     if codec == "int4_token_select":
-        return int4_token_select(hidden, importance, ratio)
+        return int4_token_select(hidden, importance, ratio, k=k)
     if codec == "affine_int8_rank":
-        mask = token_select_mask(importance, ratio, seq_len)
+        mask = token_select_mask(importance, ratio, seq_len, k=k)
         return per_token_affine_int8(hidden, mask)
     if codec == "affine_int8_top_rho":
         mask = top_rho_mask(importance, 1.0 - ratio)
@@ -98,43 +100,45 @@ def _plain_forward(cfg: ModelConfig):
 
 
 @functools.lru_cache(maxsize=None)
-def _suffix_sweep(cfg: ModelConfig, layer: int, codec: str):
+def _suffix_sweep(cfg: ModelConfig, layer: int, codec: str, tail: int):
     """Jitted: boundary hiddens at ``layer`` -> (ratio, window) NLL matrix.
 
     Two nested vmaps: the reference's batched-over-ratios intent
     (``pythia_model.py:36-54``, one batch row per ratio) plus a window-batch
     axis, so W evaluation windows x R ratios run as ONE batched suffix
     executable. Per-window codec scales are preserved (the reference quantizes
-    each window independently at batch 1).
+    each window independently at batch 1). The full-vocab unembed runs only on
+    the ``tail`` scoring positions (``nll_tail``) — exact, because everything
+    earlier is masked to -100 by the windowing recipe.
 
     boundary_hidden (W, S, D), targets (W, S), importance (W, S), ratios (R,)
     -> (R, W).
     """
 
     @jax.jit
-    def fn(params, boundary_hidden, targets, importance, ratios):
-        def per_ratio(ratio):
+    def fn(params, boundary_hidden, targets, importance, ratios, ks):
+        def per_ratio(ratio, k):
             def per_window(h_w, tgt_w, imp_w):
-                h = _apply_token_codec(codec, h_w[None], imp_w, ratio)
+                h = _apply_token_codec(codec, h_w[None], imp_w, ratio, k)
                 out, _ = run_layers(cfg, params, h, start=layer + 1)
-                return nll_from_logits(unembed(cfg, params, out), tgt_w[None])
+                return nll_tail(cfg, params, out, tgt_w[None], tail)
 
             return jax.vmap(per_window)(boundary_hidden, targets, importance)
 
-        return jax.vmap(per_ratio)(ratios)
+        return jax.vmap(per_ratio)(ratios, ks)
 
     return fn
 
 
 @functools.lru_cache(maxsize=None)
-def _suffix_channel(cfg: ModelConfig, layer: int, method: str):
+def _suffix_channel(cfg: ModelConfig, layer: int, method: str, tail: int):
     """Jitted: boundary hidden -> NLL under one per-channel codec."""
 
     @jax.jit
     def fn(params, boundary_hidden, targets):
         h = channel_wise_quant(boundary_hidden, method)
         out, _ = run_layers(cfg, params, h, start=layer + 1)
-        return nll_from_logits(unembed(cfg, params, out), targets)
+        return nll_tail(cfg, params, out, targets, tail)
 
     return fn
 
@@ -165,6 +169,41 @@ class SweepResult:
             "wall_s": self.wall_s,
             "ppl": self.ppl().tolist(),
         }
+
+    def table(self) -> str:
+        """Human-readable PPL table, the shape of the reference notebook's
+        results cell (``qwen2-0.5B_experiment.ipynb`` cell 12: one row per
+        (method, split layer), one column per ratio)."""
+        ppl = self.ppl()
+        lines = []
+        if "ratios" in self.axes:
+            ratios = self.axes["ratios"]
+            layers = self.axes["layers_of_interest"]
+            methods = self.axes.get("methods")
+            header = ["method", "layer"] if methods else ["layer"]
+            cols = header + [f"r={r}" for r in ratios]
+            rows = []
+            if methods:
+                for m, method in enumerate(methods):
+                    for l, layer in enumerate(layers):
+                        rows.append([method, str(layer)]
+                                    + [f"{v:.4g}" for v in ppl[m, l]])
+            else:
+                for l, layer in enumerate(layers):
+                    rows.append([str(layer)] + [f"{v:.4g}" for v in ppl[l]])
+        else:  # channel sweep: methods x layers
+            cols = ["method"] + [f"layer {l}" for l in self.axes["layers_of_interest"]]
+            rows = [[m] + [f"{v:.4g}" for v in ppl[i]]
+                    for i, m in enumerate(self.axes["methods"])]
+        widths = [max(len(c), *(len(r[i]) for r in rows)) if rows else len(c)
+                  for i, c in enumerate(cols)]
+        fmt = lambda vals: "  ".join(v.ljust(w) for v, w in zip(vals, widths))
+        lines.append(fmt(cols))
+        lines.append(fmt(["-" * w for w in widths]))
+        lines.extend(fmt(r) for r in rows)
+        lines.append(f"[{self.chunks} chunks, {self.n_tokens:.0f} scored tokens, "
+                     f"{self.wall_s:.1f}s, weighting={self.weighting}]")
+        return "\n".join(lines)
 
 
 def _load_checkpoint(path: Optional[str], axes: dict) -> Optional[dict]:
@@ -259,12 +298,19 @@ def run_token_sweep(
         ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))  # (W, S)
         targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
         counts = np.array([c.num_loss_tokens for c in group], np.float64)
+        # trg_len = num_loss_tokens + 1 (the windowing shift correction); the
+        # group's max bounds every member's scoring span, so a single static
+        # tail keeps one executable per group shape while staying exact
+        tail = max(c.num_loss_tokens + 1 for c in group)
+        # k per ratio, truncated in Python float64 exactly like the reference's
+        # int(ratio * s) (qwen_layer_wise.py:57) and the wire codecs
+        ks = jnp.asarray([int(float(r) * ids.shape[1]) for r in ratios], jnp.int32)
         stats, hiddens = stats_fn(params, ids)  # hiddens (L, W, S, D)
         for m, method in enumerate(methods):
             imp = importance_per_layer(stats, method, hw)  # (L, W, S)
             for l, layer in enumerate(layers_of_interest):
-                nlls = _suffix_sweep(cfg, int(layer), codec)(
-                    params, hiddens[layer], targets, imp[layer], ratios_arr)  # (R, W)
+                nlls = _suffix_sweep(cfg, int(layer), codec, tail)(
+                    params, hiddens[layer], targets, imp[layer], ratios_arr, ks)  # (R, W)
                 result.total_nll[m, l] += np.asarray(nlls, np.float64) @ counts
         result.n_tokens += counts.sum()
         result.chunks += len(group)
@@ -277,6 +323,11 @@ def run_token_sweep(
             _emit(metrics_path, {"chunk": group[-1].index, "n_tokens": result.n_tokens,
                                  "ppl": result.ppl().tolist()})
 
+    # windows are grouped only when they share shape AND scoring-tail length:
+    # chunk 0 scores the whole window (trg_len = max_length) and batching it
+    # with stride-tail chunks would force the group's unembed to the full
+    # window for every member — a W-fold blowup of the logits buffer
+    tail_of = lambda c: min(c.num_loss_tokens + 1, c.input_ids.shape[1] - 1)
     buffer = []
     for chunk in sliding_windows(token_ids, max_length, stride):
         if chunk.index < start_chunk:
@@ -284,6 +335,9 @@ def run_token_sweep(
         if max_chunks is not None and result.chunks + len(buffer) >= max_chunks:
             break
         if chunk.input_ids.shape[1] == max_length and window_batch > 1:
+            if buffer and tail_of(chunk) != tail_of(buffer[0]):
+                process_group(buffer)
+                buffer = []
             buffer.append(chunk)
             if len(buffer) == window_batch:
                 process_group(buffer)
@@ -357,6 +411,7 @@ def run_initial_sweep(
         if max_chunks is not None and result.chunks >= max_chunks:
             break
         ids, targets = jnp.asarray(chunk.input_ids), jnp.asarray(chunk.target_ids)
+        ks = jnp.asarray([int(0.1 * r * ids.shape[1]) for r in ratios], jnp.int32)
         stats, hiddens = stats_fn(params, ids)
         next_chunk = chunk.index + 1
         reg = regular_importance(stats.col_mean)  # (L, B, S)
@@ -369,8 +424,8 @@ def run_initial_sweep(
                 imp, codec = reg[quant_layer, 0], "affine_int8_top_rho"
             else:
                 imp, codec = reg[int(spec), 0], "affine_int8_rank"
-            nlls = _suffix_sweep(cfg, quant_layer, codec)(
-                params, hiddens[quant_layer], targets, imp[None], fracs)  # (R, 1)
+            nlls = _suffix_sweep(cfg, quant_layer, codec, chunk.num_loss_tokens + 1)(
+                params, hiddens[quant_layer], targets, imp[None], fracs, ks)  # (R, 1)
             result.total_nll[l] += np.asarray(nlls)[:, 0]
         result.n_tokens += chunk.num_loss_tokens
         result.chunks += 1
@@ -427,7 +482,9 @@ def run_channel_sweep(
         next_chunk = chunk.index + 1
         for m, method in enumerate(methods):
             for l, layer in enumerate(layers_of_interest):
-                nll = _suffix_channel(cfg, int(layer), method)(params, hiddens[layer], targets)
+                nll = _suffix_channel(cfg, int(layer), method,
+                                      chunk.num_loss_tokens + 1)(
+                    params, hiddens[layer], targets)
                 result.total_nll[m, l] += float(nll) * chunk.num_loss_tokens
         result.n_tokens += chunk.num_loss_tokens
         result.chunks += 1

@@ -32,14 +32,20 @@ from .windowing import sliding_windows
 def parse_hop_codec(spec: str) -> object:
     """Codec spec -> registry name or WireCodec.
 
-    Plain names pass through (``"int4_per_token"``); token-selective specs use
-    ``"selective_int4:<ratio>[:<high>]"``, e.g. ``"selective_int4:0.25:bf16"``.
+    Plain names pass through (``"int4_per_token"``, ``"int8_per_token_pallas"``);
+    token-selective specs use ``"selective_int4:<ratio>[:<high>]"`` (e.g.
+    ``"selective_int4:0.25:bf16"``) or ``"selective_int4_pallas:..."`` to pin
+    the fused-kernel implementation explicitly.
     """
     if not spec.startswith("selective_int4"):
         return spec
     parts = spec.split(":")
     ratio = float(parts[1]) if len(parts) > 1 else 0.25
     high = parts[2] if len(parts) > 2 else "bf16"
+    if parts[0].endswith("_pallas"):
+        from ..codecs.pallas_kernels import pallas_selective_int4
+
+        return pallas_selective_int4(ratio, high)
     return selective_int4(ratio, high)
 
 
@@ -67,6 +73,7 @@ def run_split_eval(
     mesh=None,
     max_chunks: Optional[int] = None,
     progress=None,
+    time_hops: bool = True,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
 
@@ -90,6 +97,9 @@ def run_split_eval(
     hw = None if head_weights is None else jnp.asarray(head_weights)
 
     total_nll, n_tokens, chunks = 0.0, 0.0, 0
+    fwd_tokens = 0  # every token pushed through the pipeline (incl. overlap)
+    hop_bytes_total = [0] * len(rt.codecs)  # measured per chunk, tail included
+    bytes_cache: dict = {}
     t0 = time.monotonic()
     for chunk in sliding_windows(token_ids, max_length, stride):
         if max_chunks is not None and chunks >= max_chunks:
@@ -104,20 +114,37 @@ def run_split_eval(
         nll = float(nll_from_logits(logits, jnp.asarray(chunk.target_ids)))
         total_nll += nll * chunk.num_loss_tokens
         n_tokens += chunk.num_loss_tokens
+        s_chunk = int(ids.shape[1])
+        fwd_tokens += s_chunk
+        if s_chunk not in bytes_cache:  # payloads are shape-determined
+            bytes_cache[s_chunk] = rt.hop_bytes(1, s_chunk)
+        for i, b in enumerate(bytes_cache[s_chunk]):
+            hop_bytes_total[i] += b
         chunks += 1
         if progress:
             progress(chunk.index)
     wall = time.monotonic() - t0
 
     seq = min(max_length, len(np.asarray(token_ids).reshape(-1)))
-    return {
+    result = {
         "ppl": float(np.exp(total_nll / max(n_tokens, 1e-9))),
         "total_nll": total_nll,
         "n_tokens": n_tokens,
         "chunks": chunks,
         "wall_s": wall,
+        "tokens_per_s": fwd_tokens / max(wall, 1e-9),
+        "scored_tokens_per_s": n_tokens / max(wall, 1e-9),
         "cuts": list(split.cuts),
         "hop_codecs": [c.name for c in rt.codecs],
+        # analytic per-token rate at the steady window size, plus the ACTUAL
+        # byte totals accumulated chunk by chunk (short tail windows and
+        # selective codecs' length-dependent splits included)
         "bytes_per_token_per_hop": rt.bytes_per_token(seq),
+        "measured_hop_bytes_total": hop_bytes_total,
+        "measured_bytes_per_fwd_token_per_hop": [
+            b / max(fwd_tokens, 1) for b in hop_bytes_total],
         "mesh": dict(mesh.shape),
     }
+    if time_hops and chunks:
+        result["per_hop_ms"] = rt.time_hops(1, seq)
+    return result

@@ -1,0 +1,123 @@
+"""Direct safetensors -> parameter-pytree loading, no torch import required.
+
+The reference can only materialize weights through
+``AutoModelForCausalLM.from_pretrained`` (two full torch model instances per
+experiment, ``Qwen2-0.5B/main.py:126-134``). Here checkpoints load straight
+from the safetensors container into the stacked-layer pytree: the format is an
+8-byte little-endian header length, a JSON header mapping tensor names to
+``{dtype, shape, data_offsets}``, then one flat data buffer — trivially
+readable with numpy alone. bf16 tensors (no numpy dtype) are upcast to fp32 by
+bit-shifting into the float32 mantissa layout.
+
+Entry points:
+- :func:`read_safetensors` — one ``.safetensors`` file -> dict of np arrays;
+- :func:`load_checkpoint` — a file or an HF model directory (handles the
+  multi-shard ``model.safetensors.index.json`` layout and builds the
+  :class:`ModelConfig` from the directory's ``config.json``) -> (cfg, params).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+from types import SimpleNamespace
+from typing import Optional
+
+import numpy as np
+
+from .configs import ModelConfig
+from .hf_loader import config_from_hf, params_from_state_dict
+
+_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+    # BF16 handled specially (no numpy dtype)
+}
+
+
+def _bf16_to_f32(raw: np.ndarray) -> np.ndarray:
+    """uint16 bf16 bit patterns -> float32 (shift into the high mantissa half)."""
+    return (raw.astype(np.uint32) << 16).view(np.float32)
+
+
+def read_safetensors(path: str) -> dict:
+    """Parse one ``.safetensors`` file into {name: np.ndarray} (bf16 -> fp32)."""
+    with open(path, "rb") as f:
+        (header_len,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(header_len))
+        data = f.read()
+    out = {}
+    for name, meta in header.items():
+        if name == "__metadata__":
+            continue
+        start, end = meta["data_offsets"]
+        buf = data[start:end]
+        shape = tuple(meta["shape"])
+        if meta["dtype"] == "BF16":
+            out[name] = _bf16_to_f32(np.frombuffer(buf, np.uint16)).reshape(shape)
+        else:
+            dt = _DTYPES.get(meta["dtype"])
+            if dt is None:
+                raise ValueError(f"unsupported safetensors dtype {meta['dtype']!r} "
+                                 f"for tensor {name!r}")
+            out[name] = np.frombuffer(buf, dt).reshape(shape)
+    return out
+
+
+def _read_dir_tensors(model_dir: str) -> dict:
+    """All tensors of an HF model directory (single- or multi-shard layout)."""
+    index_path = os.path.join(model_dir, "model.safetensors.index.json")
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        tensors = {}
+        for shard in sorted(set(index["weight_map"].values())):
+            tensors.update(read_safetensors(os.path.join(model_dir, shard)))
+        return tensors
+    single = os.path.join(model_dir, "model.safetensors")
+    if os.path.exists(single):
+        return read_safetensors(single)
+    candidates = [f for f in os.listdir(model_dir) if f.endswith(".safetensors")]
+    if len(candidates) == 1:
+        return read_safetensors(os.path.join(model_dir, candidates[0]))
+    raise FileNotFoundError(
+        f"no model.safetensors(.index.json) in {model_dir!r} (found: {candidates})")
+
+
+def config_from_dir(model_dir: str) -> ModelConfig:
+    """Build the ModelConfig from a directory's ``config.json`` (no transformers
+    import — the JSON keys are read through the same mapping as
+    :func:`config_from_hf`)."""
+    with open(os.path.join(model_dir, "config.json")) as f:
+        raw = json.load(f)
+    return config_from_hf(SimpleNamespace(**raw))
+
+
+def load_checkpoint(path: str, cfg: Optional[ModelConfig] = None):
+    """(cfg, params) from a ``.safetensors`` file or an HF model directory.
+
+    For a bare file, ``cfg`` must be supplied (e.g. a preset); for a directory
+    it is read from ``config.json`` unless overridden. This is the torch-free
+    path that makes ``run.py --weights model.safetensors`` work the moment a
+    checkpoint artifact appears.
+    """
+    if os.path.isdir(path):
+        cfg = cfg or config_from_dir(path)
+        sd = _read_dir_tensors(path)
+    else:
+        if cfg is None:
+            raise ValueError("loading a bare .safetensors file requires a ModelConfig "
+                             "(pass --model <preset>)")
+        sd = read_safetensors(path)
+    if cfg.tie_word_embeddings and "lm_head.weight" in sd and \
+            "model.embed_tokens.weight" not in sd:
+        # some exports store only the tied head; the loader expects the embed key
+        sd["model.embed_tokens.weight"] = sd["lm_head.weight"]
+    return cfg, params_from_state_dict(cfg, sd)

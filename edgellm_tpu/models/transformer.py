@@ -98,16 +98,24 @@ def _norm(cfg: ModelConfig, x, scale, bias):
 
 
 def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
-              capture_stats: bool) -> tuple[jnp.ndarray, Optional[tuple]]:
+              capture_stats: bool,
+              tp_axis: Optional[str] = None) -> tuple[jnp.ndarray, Optional[tuple]]:
     """Eager-math attention (explicit softmax) with optional reduced-stat capture.
 
     The explicit-softmax formulation is what lets importance statistics fall out of
     the same pass (the constraint the reference hit with SDPA at
     ``last_row_exp.py:93-95``). XLA fuses the mask+softmax chain; the matmuls hit
     the MXU with fp32 accumulation.
+
+    Head counts derive from the *weight shapes*, not the config, so the same code
+    runs a tensor-parallel shard: with q/k/v columns split head-contiguously
+    along ``tp_axis``, each device attends over its local heads and the row-split
+    output projection's partial product is ``psum``-reduced across the axis
+    (Megatron-style column/row pairing, expressed as a shard_map collective).
     """
     b, s, d = x.shape
-    h, kv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    hd = cfg.head_dim
+    h, kv = lp["wq"].shape[-1] // hd, lp["wk"].shape[-1] // hd  # local heads
 
     q = (x @ lp["wq"]).reshape(b, s, h, hd)
     k = (x @ lp["wk"]).reshape(b, s, kv, hd)
@@ -128,6 +136,8 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
         # eager-attention model (last_row_exp.py:68).
         out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape(b, s, h * hd) @ lp["wo"]
+        if tp_axis is not None:
+            out = jax.lax.psum(out, tp_axis)
         if "bo" in lp:
             out = out + lp["bo"]
         return out, None
@@ -147,6 +157,8 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
     out = jnp.einsum("bhst,bthd->bshd", probs.astype(x.dtype), v,
                      preferred_element_type=jnp.float32).astype(x.dtype)
     out = out.reshape(b, s, h * hd) @ lp["wo"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
     if "bo" in lp:
         out = out + lp["bo"]
 
@@ -154,27 +166,38 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
     return out, stats
 
 
-def mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray) -> jnp.ndarray:
+def mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+        tp_axis: Optional[str] = None) -> jnp.ndarray:
+    """MLP; with ``tp_axis`` set, the hidden (F) axis is column-split per device
+    and the row-split down-projection is ``psum``-reduced (biases that live on
+    the model axis — ``b_in`` — are local; output biases are added post-psum)."""
     if cfg.family == "gpt_neox":
         hidden = x @ lp["w_in"] + lp["b_in"]
         hidden = jax.nn.gelu(hidden, approximate=False)
-        return hidden @ lp["w_out"] + lp["b_out"]
-    return (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+        out = hidden @ lp["w_out"]
+        if tp_axis is not None:
+            out = jax.lax.psum(out, tp_axis)
+        return out + lp["b_out"]
+    out = (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    return out
 
 
 def block(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray, cos, sin,
-          capture_stats: bool) -> tuple[jnp.ndarray, Optional[tuple]]:
+          capture_stats: bool,
+          tp_axis: Optional[str] = None) -> tuple[jnp.ndarray, Optional[tuple]]:
     """One decoder block. GPT-NeoX: parallel residual; Qwen2: sequential."""
     if cfg.family == "gpt_neox":
         attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
-        attn_out, stats = attention(cfg, lp, attn_in, cos, sin, capture_stats)
+        attn_out, stats = attention(cfg, lp, attn_in, cos, sin, capture_stats, tp_axis)
         mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
-        return hidden + attn_out + mlp(cfg, lp, mlp_in), stats
+        return hidden + attn_out + mlp(cfg, lp, mlp_in, tp_axis), stats
     attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
-    attn_out, stats = attention(cfg, lp, attn_in, cos, sin, capture_stats)
+    attn_out, stats = attention(cfg, lp, attn_in, cos, sin, capture_stats, tp_axis)
     hidden = hidden + attn_out
     mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
-    return hidden + mlp(cfg, lp, mlp_in), stats
+    return hidden + mlp(cfg, lp, mlp_in, tp_axis), stats
 
 
 def embed(params: dict, input_ids: jnp.ndarray) -> jnp.ndarray:
@@ -272,15 +295,41 @@ def nll_from_logits(logits: jnp.ndarray, target_ids: jnp.ndarray,
     valid positions (over the whole batch, or per row for the batched-over-ratios
     scheme of ``pythia_model.py:36-54``).
     """
-    logits = logits[:, :-1, :].astype(jnp.float32)
-    targets = target_ids[:, 1:]
+    return _masked_ce(logits[:, :-1, :], target_ids[:, 1:], per_example)
+
+
+def _masked_ce(logits: jnp.ndarray, targets: jnp.ndarray,
+               per_example: bool) -> jnp.ndarray:
+    """Mean cross-entropy over positions where ``targets != -100``; logits and
+    targets are already shift-aligned (logits[b, i] predicts targets[b, i])."""
     valid = targets != -100
     safe_targets = jnp.where(valid, targets, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     tok_nll = -jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
     tok_nll = jnp.where(valid, tok_nll, 0.0)
     axes = (1,) if per_example else None
     return jnp.sum(tok_nll, axis=axes) / jnp.maximum(jnp.sum(valid, axis=axes), 1)
+
+
+def nll_tail(cfg: ModelConfig, params: dict, hidden: jnp.ndarray,
+             target_ids: jnp.ndarray, tail: int,
+             per_example: bool = False) -> jnp.ndarray:
+    """``nll_from_logits(unembed(cfg, params, hidden), target_ids)`` with the
+    unembed restricted to the ``tail`` scoring positions.
+
+    The sliding-window recipe masks every target outside the last ``trg_len``
+    positions to -100 (``Qwen2-0.5B/main.py:152-156``), so with stride 32 only
+    ~6% of a 512-token window is ever scored — yet the full-vocab unembed
+    (151k columns for Qwen2) dominates suffix FLOPs. Valid targets occupy the
+    last ``trg_len`` positions; their (shifted) logits come from hidden positions
+    ``[S - trg_len - 1, S - 2]``, so unembedding the last ``min(tail, S-1)``
+    pre-final positions is exact whenever ``tail >= trg_len``. ``tail`` must be
+    static (one executable per distinct tail length).
+    """
+    s = hidden.shape[1]
+    tail = min(int(tail), s - 1)
+    logits = unembed(cfg, params, hidden[:, s - 1 - tail: s - 1])
+    return _masked_ce(logits, target_ids[:, s - tail:], per_example)
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> dict:

@@ -5,8 +5,9 @@ layers", activations compressed across each cut — ``README.md:16-23``) onto a 
 mesh:
 
 - mesh axes: ``("stage", "data", "model")`` — pipeline stages (explicit
-  ``ppermute`` hops), data parallelism over evaluation windows, and optional
-  tensor parallelism of the per-stage weights (GSPMD inserts the collectives).
+  ``ppermute`` hops), data parallelism over evaluation windows, and tensor
+  parallelism of the per-stage weights (Megatron-style column/row splits with
+  an explicit in-block ``psum`` — see ``place_params._layer_pspec``).
 - each stage owns a contiguous slice of the stacked layer parameters; stages are
   padded to equal layer counts with zero layers that are masked to identity, so
   the whole pipeline is one ``shard_map`` body with a static stage unroll.
@@ -24,6 +25,7 @@ code with two cuts.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Optional, Sequence
 
 import numpy as np
@@ -102,6 +104,23 @@ class SplitRuntime:
         self.codecs: list[WireCodec] = [
             c if isinstance(c, WireCodec) else get_wire_codec(c)
             for c in split.hop_codecs]
+        # On TPU the fused Pallas kernels are the default boundary-codec
+        # implementation (bit-identical to the jnp twins — tested); EDGELLM_PALLAS
+        # forces substitution on (=1) or off (=0) on any backend.
+        flag = os.environ.get("EDGELLM_PALLAS")
+        if flag == "1" or (flag is None and jax.default_backend() == "tpu"):
+            from ..codecs.pallas_kernels import pallas_variant
+
+            self.codecs = [pallas_variant(c) or c for c in self.codecs]
+        n_model = mesh.shape["model"]
+        if n_model > 1:
+            bad = [(name, dim) for name, dim in
+                   [("num_heads", cfg.num_heads), ("num_kv_heads", cfg.num_kv_heads),
+                    ("intermediate_size", cfg.intermediate_size)] if dim % n_model]
+            if bad:
+                raise ValueError(
+                    f"tensor parallelism n_model={n_model} requires head/FFN dims "
+                    f"divisible by the axis; offending: {bad}")
         n_stages = split.n_stages
         if mesh.shape["stage"] != n_stages:
             raise ValueError(
@@ -132,16 +151,37 @@ class SplitRuntime:
             groups[k] = arr
         return groups, valid
 
+    # Megatron-style column/row pairing for the "model" axis: the first matmul
+    # of each pair is column-split (head-contiguous for q/k/v, F-contiguous for
+    # the MLP up/gate), the second is row-split, and the row-split partial
+    # product is psum-reduced inside the block (transformer.attention/mlp).
+    _TP_COL_SPLIT = frozenset(
+        {"wq", "wk", "wv", "bq", "bk", "bv", "w_gate", "w_up", "w_in", "b_in"})
+    _TP_ROW_SPLIT = frozenset({"wo", "w_down", "w_out"})
+
+    def _layer_pspec(self, key: str, ndim: int) -> P:
+        """PartitionSpec for one stacked layer-group array (n_stages, sz, ...)."""
+        if self.mesh.shape["model"] > 1:
+            if key in self._TP_COL_SPLIT:  # split the last (output-feature) axis
+                return P(*(("stage",) + (None,) * (ndim - 2) + ("model",)))
+            if key in self._TP_ROW_SPLIT:  # split the input-feature axis
+                return P("stage", None, "model")
+        return P("stage")
+
     def place_params(self, params: dict) -> dict:
         """Shard the parameter pytree over the mesh: layer groups along "stage",
-        everything else replicated. (Tensor parallelism along "model" stays at
-        GSPMD's discretion via these annotations; hidden activations are sharded
-        along "data" on the batch axis.)"""
+        attention/MLP weights additionally column/row-split along "model"
+        (real tensor parallelism — each model-axis device holds 1/n of the
+        heads and FFN columns and computes its slice; see ``_layer_pspec``),
+        everything else replicated. Hidden activations ride the "data" axis on
+        the batch dimension."""
         groups, valid = self._regroup_layers(params["layers"])
         stage_spec = NamedSharding(self.mesh, P("stage"))
         repl = NamedSharding(self.mesh, P())
         placed = {
-            "layers": {k: jax.device_put(v, stage_spec) for k, v in groups.items()},
+            "layers": {
+                k: jax.device_put(v, NamedSharding(self.mesh, self._layer_pspec(k, v.ndim)))
+                for k, v in groups.items()},
             "layers_valid": jax.device_put(valid, stage_spec),
         }
         for k, v in params.items():
@@ -156,8 +196,11 @@ class SplitRuntime:
         codecs = self.codecs
         mesh = self.mesh
 
+        tp_axis = "model" if mesh.shape["model"] > 1 else None
+
         def stage_body(local_layers, local_valid, hidden, cos, sin, hop_imps):
-            """Runs inside shard_map: one device = one pipeline stage."""
+            """Runs inside shard_map: one device = one pipeline stage (and one
+            tensor-parallel shard of it when the "model" axis is populated)."""
             idx = jax.lax.axis_index("stage")
             lv = {k: v[0] for k, v in local_layers.items()}  # (sz, ...)
             valid = local_valid[0]  # (sz,)
@@ -167,7 +210,8 @@ class SplitRuntime:
 
             def scan_body(h, xs):
                 lp, ok = xs
-                out, _ = block(cfg, lp, h, cos, sin, capture_stats=False)
+                out, _ = block(cfg, lp, h, cos, sin, capture_stats=False,
+                               tp_axis=tp_axis)
                 return jnp.where(ok, out, h), None
 
             for s in range(n_stages):
@@ -189,11 +233,13 @@ class SplitRuntime:
         # windows); each data-parallel group runs the full pipeline over "stage"
         batch_spec = P("data") if mesh.shape["data"] > 1 else P()
 
+        layer_pspec = self._layer_pspec
+
         @jax.jit
         def fn(placed, input_ids, hop_imps):
             hidden = embed(placed, input_ids)
             cos, sin = precompute_rope(cfg, input_ids.shape[1])
-            lspecs = jax.tree_util.tree_map(lambda _: P("stage"), placed["layers"])
+            lspecs = {k: layer_pspec(k, v.ndim) for k, v in placed["layers"].items()}
             out = shard_map(
                 stage_body,
                 mesh=mesh,
@@ -220,6 +266,16 @@ class SplitRuntime:
         imps = list(hop_importance) if hop_importance is not None else [None] * n_hops
         if len(imps) != n_hops:
             raise ValueError(f"expected {n_hops} hop_importance entries, got {len(imps)}")
+        needs = [c.needs_importance for c in self.codecs]
+        if any(needs) and input_ids.shape[0] > 1:
+            # one (S,) importance vector cannot speak for several evaluation
+            # windows: each window has its own token ordering in the reference
+            # (Qwen2-0.5B/main.py:161-165); silently sharing one would diverge
+            raise ValueError(
+                f"token-selective hop codecs "
+                f"{[c.name for c, n in zip(self.codecs, needs) if n]} take one "
+                f"importance vector per forward; run batch=1 windows (got batch "
+                f"{input_ids.shape[0]})")
         for c, imp in zip(self.codecs, imps):
             if c.needs_importance and imp is None:
                 raise ValueError(f"hop codec {c.name} requires an importance vector")
@@ -238,3 +294,36 @@ class SplitRuntime:
     def bytes_per_token(self, seq: int) -> list:
         """Per-hop boundary bytes per token (the BASELINE.json metric)."""
         return [b / seq for b in self.hop_bytes(1, seq)]
+
+    def time_hops(self, batch: int, seq: int, iters: int = 20) -> list:
+        """Measured per-hop boundary-transfer time (ms): encode -> ppermute ->
+        decode of one (batch, seq, D) activation, isolated from the stage
+        compute so the observability numbers attribute wire cost separately
+        (the reference has no transfer at all to time — SURVEY.md section 5).
+        """
+        from ..utils.profiling import timed
+
+        results = []
+        mesh = self.mesh
+        hidden = jax.random.normal(
+            jax.random.key(0), (batch, seq, self.cfg.hidden_size), jnp.float32)
+        imp = jnp.arange(seq, dtype=jnp.float32)
+        for s, codec in enumerate(self.codecs):
+
+            def hop_body(h):
+                idx = jax.lax.axis_index("stage")
+                if codec.needs_importance:
+                    payload = codec.encode(h, imp)
+                else:
+                    payload = codec.encode(h)
+                moved = jax.tree_util.tree_map(
+                    lambda a: jax.lax.ppermute(a, "stage", [(s, s + 1)]), payload)
+                decoded = codec.decode(moved)
+                return jax.lax.psum(
+                    jnp.where(idx == s + 1, decoded, jnp.zeros_like(decoded)), "stage")
+
+            fn = jax.jit(shard_map(hop_body, mesh=mesh, in_specs=P(),
+                                   out_specs=P(), check_vma=False))
+            sec, _ = timed(fn, hidden, warmup=1, iters=iters)
+            results.append(sec * 1000.0)
+        return results

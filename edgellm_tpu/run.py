@@ -57,6 +57,19 @@ def _load_model(args):
     from .models import PRESETS, init_params, params_from_state_dict, config_from_hf
 
     if args.weights:
+        # torch-free fast path: .safetensors file, or an HF directory laid out
+        # with safetensors shards + config.json
+        from .models.safetensors_io import load_checkpoint
+
+        if args.weights.endswith(".safetensors"):
+            if args.model not in PRESETS:
+                raise SystemExit(f"--model must be one of {sorted(PRESETS)} with a "
+                                 f"bare .safetensors file")
+            return load_checkpoint(args.weights, PRESETS[args.model])
+        if os.path.isdir(args.weights) and any(
+                f.endswith(".safetensors") for f in os.listdir(args.weights)):
+            return load_checkpoint(args.weights)
+
         import torch
 
         if os.path.isdir(args.weights):
@@ -92,6 +105,9 @@ def main(argv=None) -> int:
     ap.add_argument("--head-weights", help="LRP head weights .json (L x H) for weighted_importance")
     ap.add_argument("--output-dir", default=".")
     ap.add_argument("--max-chunks", type=int, help="stop after N chunks (smoke/CI)")
+    ap.add_argument("--window-batch", type=int, default=8,
+                    help="evaluation windows batched per forward in the token "
+                         "sweep (identical accumulation; feeds the MXU)")
     ap.add_argument("--checkpoint-every", type=int, default=1000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--synthetic-corpus-len", type=int, default=4096)
@@ -174,10 +190,12 @@ def main(argv=None) -> int:
         result = run_token_sweep(
             cfg, params, corpus, methods=methods or ["regular_importance"],
             layers_of_interest=params_json["layers_of_interest"],
-            ratios=params_json["ratios"], head_weights=head_weights, **common)
+            ratios=params_json["ratios"], head_weights=head_weights,
+            window_batch=max(args.window_batch, 1), **common)
 
     with open(out("avg_ppl_results.json"), "w") as f:
         json.dump(result.to_json(), f, indent=1)
+    print(result.table())
     print(json.dumps({"chunks": result.chunks, "n_tokens": result.n_tokens,
                       "wall_s": round(result.wall_s, 3),
                       "ppl": np.round(result.ppl(), 4).tolist()}))

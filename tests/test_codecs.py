@@ -87,7 +87,9 @@ def test_int4_values_live_on_16_level_grid(hidden, rng):
 
 def test_token_select_mask_ties_break_like_stable_argsort():
     imp = jnp.asarray([0.5, 0.2, 0.2, 0.9, 0.2])
-    mask = np.asarray(token_select_mask(imp, 0.6, 5))  # k = 3 least important
+    # 3/5 is exact in binary: int(0.6000000000000001 * 5) would be fragile, so
+    # pick k via an exactly-representable ratio
+    mask = np.asarray(token_select_mask(imp, 3 / 5 + 1e-12, 5))  # k = 3
     # stable ascending: positions 1, 2, 4 (the tied 0.2s in original order)
     np.testing.assert_array_equal(mask, [False, True, True, False, True])
 
@@ -107,6 +109,21 @@ def test_channel_wise_matches_reference_loop(hidden, method):
     got = np.asarray(channel_wise_quant(jnp.asarray(hidden), method))
     want = _oracle_channel_wise(hidden, method)
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_token_select_k_truncates_in_float64():
+    """ratio * S products just below an integer must truncate like the
+    reference's float64 int(ratio * s): 0.29 * 100 = 28.999... -> k = 28, while
+    a float32 floor of the traced product rounds up to 29 (the simulate-vs-wire
+    one-token divergence the advisor flagged)."""
+    assert int(0.29 * 100) == 28  # float64
+    assert int(np.floor(np.float32(0.29) * 100)) == 29  # the traced fallback
+    imp = jnp.arange(100.0)
+    mask = np.asarray(token_select_mask(imp, 0.29, 100))
+    assert mask.sum() == 28  # float64 truncation, not float32 floor
+    # explicit k overrides agree with the wire codec's int(ratio * s)
+    mask_k = np.asarray(token_select_mask(imp, 0.29, 100, k=int(0.29 * 100)))
+    np.testing.assert_array_equal(mask, mask_k)
 
 
 def test_per_token_affine_int8_roundtrip(hidden):
