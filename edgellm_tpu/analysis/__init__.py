@@ -5,6 +5,8 @@ from .distances import (
     jensen_shannon_divergence,
     layer_importance_distributions,
     pairwise_layer_distances,
+    bucket_lengths,
+    save_heatmap,
 )
 
 __all__ = [
@@ -12,4 +14,6 @@ __all__ = [
     "jensen_shannon_divergence",
     "layer_importance_distributions",
     "pairwise_layer_distances",
+    "bucket_lengths",
+    "save_heatmap",
 ]

@@ -51,21 +51,47 @@ def _per_layer_importance(cfg: ModelConfig):
     return fn
 
 
+def bucket_lengths(lengths: Sequence[int], max_buckets: int) -> list:
+    """Pick <= max_buckets clip lengths (ascending) covering a ragged corpus.
+
+    Quantile-spaced over the distinct lengths so short and long samples each
+    get a nearby bucket; every sample is clipped DOWN to the largest bucket
+    <= its length (samples shorter than the smallest bucket keep their native
+    length — at most max_buckets extra compiles in pathological corpora).
+    """
+    distinct = sorted(set(int(l) for l in lengths))
+    if len(distinct) <= max_buckets:
+        return distinct
+    qs = np.linspace(0, len(distinct) - 1, max_buckets).round().astype(int)
+    return [distinct[i] for i in sorted(set(qs))]
+
+
 def layer_importance_distributions(cfg: ModelConfig, params,
-                                   samples: Sequence[np.ndarray]) -> list:
+                                   samples: Sequence[np.ndarray],
+                                   max_compiles: int | None = None) -> list:
     """Per-sample regular-importance distributions: list over L layers of lists
     over samples of (S_i,) arrays (the notebook's ``all_distributions``).
 
-    Samples run at their native lengths, like the notebook's per-line forwards —
-    each DISTINCT length compiles the stats forward once. For large ragged
-    corpora, pre-bucket or clip samples to a few fixed lengths to bound
-    compilation time.
+    Samples run at their native lengths by default, like the notebook's
+    per-line forwards — each DISTINCT length compiles the stats forward once.
+    ``max_compiles`` bounds that for large ragged corpora: samples are clipped
+    down to <= max_compiles bucket lengths (``bucket_lengths``). Clipping keeps
+    the analysis exact *for the analyzed prefix* — every layer of a sample sees
+    the same tokens, which is all the layer-pair JS comparison needs — unlike
+    padding, which would let pad positions perturb the attention statistics.
     """
     fn = _per_layer_importance(cfg)
+    samples = [np.asarray(s).reshape(-1) for s in samples]
+    if max_compiles is not None:
+        buckets = bucket_lengths([s.shape[0] for s in samples], max_compiles)
+        clipped = []
+        for s in samples:
+            fits = [b for b in buckets if b <= s.shape[0]]
+            clipped.append(s[: fits[-1]] if fits else s)
+        samples = clipped
     out = [[] for _ in range(cfg.num_layers)]
     for ids in samples:
-        ids = np.asarray(ids).reshape(1, -1)
-        imp = np.asarray(fn(params, jnp.asarray(ids)))
+        imp = np.asarray(fn(params, jnp.asarray(ids[None, :])))
         for layer in range(cfg.num_layers):
             out[layer].append(imp[layer])
     return out
@@ -83,3 +109,27 @@ def pairwise_layer_distances(distributions: list) -> np.ndarray:
                 acc += jensen_shannon_divergence(p, q)
             results[i, j] = acc / len(distributions[i])
     return results
+
+
+def save_heatmap(matrix: np.ndarray, path: str, title: str = "JS divergence "
+                 "between layer importance distributions") -> None:
+    """The notebook's cell-18 seaborn heatmap as a matplotlib artifact."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots(figsize=(1 + 0.5 * matrix.shape[0],) * 2)
+    im = ax.imshow(matrix, cmap="viridis")
+    fig.colorbar(im, ax=ax)
+    for i in range(matrix.shape[0]):
+        for j in range(matrix.shape[1]):
+            if np.isfinite(matrix[i, j]):
+                ax.text(j, i, f"{matrix[i, j]:.2f}", ha="center", va="center",
+                        color="white", fontsize=7)
+    ax.set_xlabel("layer")
+    ax.set_ylabel("layer")
+    ax.set_title(title, fontsize=9)
+    fig.tight_layout()
+    fig.savefig(path, dpi=120)
+    plt.close(fig)

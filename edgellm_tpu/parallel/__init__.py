@@ -9,7 +9,9 @@ devices as a *packed, quantized* payload via ``lax.ppermute`` inside
 CPU mesh the tests use.
 """
 from .split import SplitConfig, SplitRuntime, make_stage_mesh
-from .ring import ring_attention, forward_sp, make_seq_mesh
+from .ring import (ring_attention, forward_sp, make_seq_mesh,
+                   SplitRingRuntime, make_sp_stage_mesh)
 
 __all__ = ["SplitConfig", "SplitRuntime", "make_stage_mesh",
-           "ring_attention", "forward_sp", "make_seq_mesh"]
+           "ring_attention", "forward_sp", "make_seq_mesh",
+           "SplitRingRuntime", "make_sp_stage_mesh"]

@@ -11,7 +11,9 @@ ring-attention construction (Liu et al.; see PAPERS.md) on XLA collectives
 instead of NCCL P2P.
 
 Composability: the "seq" axis is orthogonal to the split runtime's "stage" axis —
-a config can pipeline-split the layer stack AND ring-shard the sequence.
+:class:`SplitRingRuntime` below pipeline-splits the layer stack AND ring-shards
+the sequence on a ("stage", "seq") mesh, with per-token-compressed boundary hops
+(tested equal to the dense forward in ``tests/test_ring.py``).
 
 Everything is jit-safe: the ring loop is a ``lax.fori_loop`` with static block
 shapes; the causal mask is computed from global block offsets.
@@ -169,3 +171,129 @@ def forward_sp(cfg: ModelConfig, params, input_ids, mesh: Mesh,
     full fp32 logits. Weights replicated, activations 1/n per device, attention
     via the K/V ring."""
     return _sp_forward(cfg, mesh, axis_name)(params, jnp.asarray(input_ids))
+
+
+# ---------- stage x seq composition ----------
+
+
+def make_sp_stage_mesh(n_stages: int, n_seq: int, devices=None) -> Mesh:
+    """("stage", "seq") mesh: pipeline stages x ring-attention sequence shards."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    need = n_stages * n_seq
+    if devices.size < need:
+        raise ValueError(f"need {need} devices, have {devices.size}")
+    return Mesh(devices.reshape(-1)[:need].reshape(n_stages, n_seq),
+                ("stage", "seq"))
+
+
+class SplitRingRuntime:
+    """Pipeline-split forward with each stage's sequence ring-sharded.
+
+    The composition claimed at the top of this module, made concrete: the layer
+    stack is cut into stages along "stage" (stage-sharded parameter groups,
+    boundary activations crossing by ``ppermute`` exactly like
+    ``split.SplitRuntime``) while WITHIN every stage the sequence axis is
+    sharded over "seq" and attention runs as the K/V ring. Boundary hops move
+    each device's local 1/n_seq sequence shard — with a per-token wire codec,
+    the compressed payload — so long contexts never gather onto one device at
+    the cut either.
+
+    Hop codecs must be per-token (``batch_invariant``): their scales reduce only
+    over the feature axis, so encoding a sequence shard locally is identical to
+    encoding the full sequence. Global/selective codecs would need a collective
+    over "seq" to agree on scales/ordering and are rejected.
+    """
+
+    def __init__(self, cfg: ModelConfig, cuts, hop_codecs, mesh: Mesh):
+        from .split import SplitConfig, apply_default_codec_backend
+        from ..codecs.packing import WireCodec, get_wire_codec
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self.split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(hop_codecs))
+        self.codecs = apply_default_codec_backend(
+            [c if isinstance(c, WireCodec) else get_wire_codec(c)
+             for c in self.split.hop_codecs])
+        bad = [c.name for c in self.codecs if not c.batch_invariant]
+        if bad:
+            raise ValueError(
+                f"stage x seq hops need per-token codecs; {bad} reduce over "
+                f"batch/sequence and would disagree across sequence shards")
+        if mesh.shape["stage"] != self.split.n_stages:
+            raise ValueError(f"mesh has {mesh.shape['stage']} stages, split "
+                             f"needs {self.split.n_stages}")
+        self.bounds = self.split.stage_bounds(cfg.num_layers)
+        self.stage_size = max(stop - start for start, stop in self.bounds)
+        self._forward = self._build_forward()
+
+    def place_params(self, params: dict) -> dict:
+        """Stage-shard the stacked layer groups, replicate the rest (same
+        regrouping as the split runtime; no "model"/"data" axes here)."""
+        from jax.sharding import NamedSharding
+
+        from .split import regroup_layers
+
+        groups, valid = regroup_layers(params["layers"], self.bounds, self.stage_size)
+        stage_spec = NamedSharding(self.mesh, P("stage"))
+        repl = NamedSharding(self.mesh, P())
+        placed = {
+            "layers": {k: jax.device_put(v, stage_spec) for k, v in groups.items()},
+            "layers_valid": jax.device_put(valid, stage_spec),
+        }
+        for k, v in params.items():
+            if k != "layers":
+                placed[k] = jax.device_put(v, repl)
+        return placed
+
+    def _build_forward(self):
+        from .split import run_pipeline_stages
+
+        cfg, n_stages = self.cfg, self.split.n_stages
+        codecs, mesh = self.codecs, self.mesh
+
+        def body(local_layers, local_valid, other, ids_loc, cos_loc, sin_loc):
+            lv = {k: v[0] for k, v in local_layers.items()}
+            valid = local_valid[0]
+            hidden = embed(other, ids_loc)  # (B, S_loc, D), seq-sharded
+
+            def scan_body(h, xs):
+                lp, ok = xs
+                out = _sp_block(cfg, lp, h, cos_loc, sin_loc, "seq")
+                return jnp.where(ok, out, h), None
+
+            def run_stage(h):
+                computed, _ = jax.lax.scan(scan_body, h, (lv, valid))
+                return computed
+
+            # the shared hop protocol moves each device's local seq shard
+            # (per-token codecs, so shard-local encode == full-sequence encode)
+            hidden = run_pipeline_stages(n_stages, codecs, run_stage, hidden)
+            post = _norm(cfg, hidden, other["final_norm_scale"],
+                         other.get("final_norm_bias", 0.0))
+            head = other["embed"].T if cfg.tie_word_embeddings else other["lm_head"]
+            return jnp.einsum("bsd,dv->bsv", post, head,
+                              preferred_element_type=jnp.float32)
+
+        @jax.jit
+        def fn(placed, input_ids):
+            seq = input_ids.shape[1]
+            if seq % mesh.shape["seq"]:
+                raise ValueError(f"sequence length {seq} not divisible by seq "
+                                 f"axis size {mesh.shape['seq']}")
+            cos, sin = precompute_rope(cfg, seq)
+            other = {k: v for k, v in placed.items()
+                     if k not in ("layers", "layers_valid")}
+            lspecs = jax.tree_util.tree_map(lambda _: P("stage"), placed["layers"])
+            return shard_map(
+                body, mesh=mesh,
+                in_specs=(lspecs, P("stage"), P(), P(None, "seq"), P("seq"), P("seq")),
+                out_specs=P(None, "seq"),
+                check_vma=False,
+            )(placed["layers"], placed["layers_valid"], other, input_ids, cos, sin)
+
+        return fn
+
+    def forward(self, placed_params: dict, input_ids) -> jnp.ndarray:
+        """ids (B, S) -> full fp32 logits; layers stage-split, sequence
+        ring-sharded, boundary hops carry packed per-token payload shards."""
+        return self._forward(placed_params, jnp.asarray(input_ids))

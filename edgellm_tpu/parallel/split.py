@@ -51,6 +51,63 @@ def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
     return Mesh(grid, ("stage", "data", "model"))
 
 
+def apply_default_codec_backend(codecs: list) -> list:
+    """On TPU the fused Pallas kernels are the default boundary-codec
+    implementation (bit-identical to the jnp twins — tested); EDGELLM_PALLAS
+    forces substitution on (=1) or off (=0) on any backend. Shared by every
+    runtime that owns hop codecs."""
+    flag = os.environ.get("EDGELLM_PALLAS")
+    if flag == "1" or (flag is None and jax.default_backend() == "tpu"):
+        from ..codecs.pallas_kernels import pallas_variant
+
+        return [pallas_variant(c) or c for c in codecs]
+    return list(codecs)
+
+
+def regroup_layers(layers: dict, bounds: list, stage_size: int) -> tuple:
+    """(L, ...) stacked layers -> (n_stages, stage_size, ...) padded groups +
+    validity mask. Padding layers are zeros and masked to identity in the
+    stage body."""
+    n_stages = len(bounds)
+    groups, valid = {}, np.zeros((n_stages, stage_size), np.bool_)
+    for s, (start, stop) in enumerate(bounds):
+        valid[s, : stop - start] = True
+    for k, v in layers.items():
+        arr = np.zeros((n_stages, stage_size) + v.shape[1:], np.asarray(v).dtype)
+        for s, (start, stop) in enumerate(bounds):
+            arr[s, : stop - start] = np.asarray(v[start:stop])
+        groups[k] = arr
+    return groups, valid
+
+
+def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
+                        hop_imps=None, axis_name: str = "stage"):
+    """The pipeline-unroll + boundary-hop protocol, shared by SplitRuntime and
+    the stage x seq SplitRingRuntime (must run inside shard_map on
+    ``axis_name``).
+
+    Every device executes ``run_stage`` (its local layer scan) once per unroll
+    step, keeping the result only when the step index matches its stage; at
+    each cut the boundary activation is ENCODED to a packed payload, crossed to
+    the next device via ``ppermute``, and DECODED on arrival. The final psum
+    replicates the last stage's output structurally (no vma typing needed for
+    Pallas-backed codecs)."""
+    idx = jax.lax.axis_index(axis_name)
+    for s in range(n_stages):
+        computed = run_stage(hidden)
+        hidden = jnp.where(idx == s, computed, hidden)
+        if s < n_stages - 1:
+            if codecs[s].needs_importance:
+                payload = codecs[s].encode(hidden, hop_imps[s])
+            else:
+                payload = codecs[s].encode(hidden)
+            moved = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, axis_name, [(s, s + 1)]), payload)
+            hidden = jnp.where(idx == s + 1, codecs[s].decode(moved), hidden)
+    return jax.lax.psum(
+        jnp.where(idx == n_stages - 1, hidden, jnp.zeros_like(hidden)), axis_name)
+
+
 @dataclasses.dataclass(frozen=True)
 class SplitConfig:
     """Where the model is cut and what crosses each cut.
@@ -101,17 +158,9 @@ class SplitRuntime:
         self.mesh = mesh
         self.bounds = split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
-        self.codecs: list[WireCodec] = [
-            c if isinstance(c, WireCodec) else get_wire_codec(c)
-            for c in split.hop_codecs]
-        # On TPU the fused Pallas kernels are the default boundary-codec
-        # implementation (bit-identical to the jnp twins — tested); EDGELLM_PALLAS
-        # forces substitution on (=1) or off (=0) on any backend.
-        flag = os.environ.get("EDGELLM_PALLAS")
-        if flag == "1" or (flag is None and jax.default_backend() == "tpu"):
-            from ..codecs.pallas_kernels import pallas_variant
-
-            self.codecs = [pallas_variant(c) or c for c in self.codecs]
+        self.codecs: list[WireCodec] = apply_default_codec_backend(
+            [c if isinstance(c, WireCodec) else get_wire_codec(c)
+             for c in split.hop_codecs])
         n_model = mesh.shape["model"]
         if n_model > 1:
             bad = [(name, dim) for name, dim in
@@ -135,21 +184,6 @@ class SplitRuntime:
         self._forward = self._build_forward()
 
     # ---------- parameter placement ----------
-
-    def _regroup_layers(self, layers: dict) -> tuple:
-        """(L, ...) stacked layers -> (n_stages, stage_size, ...) padded groups +
-        validity mask. Padding layers are zeros and masked to identity in the
-        stage body."""
-        n_stages, sz = self.split.n_stages, self.stage_size
-        groups, valid = {}, np.zeros((n_stages, sz), np.bool_)
-        for s, (start, stop) in enumerate(self.bounds):
-            valid[s, : stop - start] = True
-        for k, v in layers.items():
-            arr = np.zeros((n_stages, sz) + v.shape[1:], np.asarray(v).dtype)
-            for s, (start, stop) in enumerate(self.bounds):
-                arr[s, : stop - start] = np.asarray(v[start:stop])
-            groups[k] = arr
-        return groups, valid
 
     # Megatron-style column/row pairing for the "model" axis: the first matmul
     # of each pair is column-split (head-contiguous for q/k/v, F-contiguous for
@@ -175,7 +209,7 @@ class SplitRuntime:
         heads and FFN columns and computes its slice; see ``_layer_pspec``),
         everything else replicated. Hidden activations ride the "data" axis on
         the batch dimension."""
-        groups, valid = self._regroup_layers(params["layers"])
+        groups, valid = regroup_layers(params["layers"], self.bounds, self.stage_size)
         stage_spec = NamedSharding(self.mesh, P("stage"))
         repl = NamedSharding(self.mesh, P())
         placed = {
@@ -201,7 +235,6 @@ class SplitRuntime:
         def stage_body(local_layers, local_valid, hidden, cos, sin, hop_imps):
             """Runs inside shard_map: one device = one pipeline stage (and one
             tensor-parallel shard of it when the "model" axis is populated)."""
-            idx = jax.lax.axis_index("stage")
             lv = {k: v[0] for k, v in local_layers.items()}  # (sz, ...)
             valid = local_valid[0]  # (sz,)
             # the carry becomes stage-varying after the first scan step; promote
@@ -214,20 +247,11 @@ class SplitRuntime:
                                tp_axis=tp_axis)
                 return jnp.where(ok, out, h), None
 
-            for s in range(n_stages):
-                computed, _ = jax.lax.scan(scan_body, hidden, (lv, valid))
-                hidden = jnp.where(idx == s, computed, hidden)
-                if s < n_stages - 1:
-                    if codecs[s].needs_importance:
-                        payload = codecs[s].encode(hidden, hop_imps[s])
-                    else:
-                        payload = codecs[s].encode(hidden)
-                    moved = jax.tree_util.tree_map(
-                        lambda a: jax.lax.ppermute(a, "stage", [(s, s + 1)]), payload)
-                    hidden = jnp.where(idx == s + 1, codecs[s].decode(moved), hidden)
-            # only the last stage's hidden is the real output; replicate it
-            return jax.lax.psum(
-                jnp.where(idx == n_stages - 1, hidden, jnp.zeros_like(hidden)), "stage")
+            def run_stage(h):
+                computed, _ = jax.lax.scan(scan_body, h, (lv, valid))
+                return computed
+
+            return run_pipeline_stages(n_stages, codecs, run_stage, hidden, hop_imps)
 
         # batch axis rides the "data" mesh axis (data parallelism over evaluation
         # windows); each data-parallel group runs the full pipeline over "stage"

@@ -12,6 +12,9 @@ Dispatch mirrors the reference:
 - ``experiment: "initial"``   -> Pythia initial sweep (affine-int8 rank / top-rho)
 - ``experiment: "last_row"``  -> token-selective int4 sweep (Pythia defaults)
 - ``experiment: "relevance"`` -> LRP head-relevance extraction
+- ``experiment: "split"``     -> real mesh-split eval (ppermute boundary hops)
+- ``experiment: "distances"`` -> layer-pair JS-divergence matrix + heatmap
+  (the ``distributions_distance_across_layers.ipynb`` cell 16-18 analysis)
 - methods containing "channel" -> per-channel codec sweep (``main.py:118-119``)
 - otherwise                   -> the Qwen-style token sweep
 
@@ -154,6 +157,41 @@ def main(argv=None) -> int:
             json.dump(np.asarray(weights).tolist(), f)
         print(json.dumps({"artifact": out("attention_head_weights.json"),
                           "shape": list(np.asarray(weights).shape)}))
+        return 0
+
+    if experiment == "distances":
+        from .analysis import (layer_importance_distributions,
+                               pairwise_layer_distances, save_heatmap)
+
+        # per-sample forwards like the notebook's per-line loop: a multi-array
+        # .npz is one sample per array; a flat corpus splits into
+        # non-overlapping max_length windows
+        if args.corpus and args.corpus.endswith(".npz"):
+            data = np.load(args.corpus)
+            samples = [np.asarray(data[f]).reshape(-1) for f in data.files]
+            for i, s in enumerate(samples):  # _load_corpus only checked files[0]
+                if s.size and (s.max() >= cfg.vocab_size or s.min() < 0):
+                    raise SystemExit(f"npz sample {i} has token ids outside "
+                                     f"[0, {cfg.vocab_size}) — wrong tokenizer?")
+        else:
+            samples = [corpus[i:i + max_length]
+                       for i in range(0, len(corpus), max_length)]
+        samples = [s for s in samples if len(s) >= 2]
+        if args.max_chunks:
+            samples = samples[: args.max_chunks]
+        dists = layer_importance_distributions(
+            cfg, params, samples, max_compiles=params_json.get("max_compiles", 4))
+        matrix = pairwise_layer_distances(dists)
+        artifact = {"matrix": [[None if not np.isfinite(v) else float(v) for v in row]
+                               for row in matrix],
+                    "n_samples": len(samples), "model": args.model}
+        with open(out("layer_distances.json"), "w") as f:
+            json.dump(artifact, f, indent=1)
+        heatmap_path = out("layer_distances.png")
+        save_heatmap(matrix, heatmap_path)
+        print(json.dumps({"artifact": out("layer_distances.json"),
+                          "heatmap": heatmap_path, "n_samples": len(samples),
+                          "layers": matrix.shape[0]}))
         return 0
 
     from .eval import run_token_sweep, run_initial_sweep, run_channel_sweep

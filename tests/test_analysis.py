@@ -47,3 +47,42 @@ def test_pairwise_layer_distances_pipeline(rng):
     assert all(np.isfinite(mat[i, j]) for i, j in upper)
     assert all(np.isnan(mat[j, i]) for i, j in upper)
     assert np.isnan(np.diag(mat)).all()
+
+
+def test_bucket_lengths_bounds_compiles():
+    from edgellm_tpu.analysis import bucket_lengths
+
+    lengths = list(range(17, 117))  # 100 distinct ragged lengths
+    buckets = bucket_lengths(lengths, 4)
+    assert len(buckets) <= 4 and buckets == sorted(buckets)
+    assert buckets[0] == 17 and buckets[-1] == 116  # extremes covered
+    # few distinct lengths pass through untouched
+    assert bucket_lengths([8, 8, 16], 4) == [8, 16]
+
+
+def test_ragged_corpus_compiles_at_most_max_compiles(rng):
+    """100 ragged samples run with <= 4 distinct stats-forward shapes (the
+    clipped lengths), verified by counting actual jit cache misses."""
+    from edgellm_tpu.analysis.distances import _per_layer_importance
+
+    params = init_params(CFG, jax.random.key(3))
+    samples = [rng.integers(0, CFG.vocab_size, n)
+               for n in rng.integers(16, 116, size=100)]
+    _per_layer_importance.cache_clear()
+    fn = _per_layer_importance(CFG)
+    dists = layer_importance_distributions(CFG, params, samples, max_compiles=4)
+    assert len(dists[0]) == 100
+    assert fn._cache_size() <= 4
+    # every clipped sample still yields a normalized distribution
+    for d in dists[0]:
+        np.testing.assert_allclose(d.sum(), 1.0, atol=1e-5)
+
+
+def test_heatmap_artifact(tmp_path, rng):
+    from edgellm_tpu.analysis import save_heatmap
+
+    mat = np.full((4, 4), np.nan)
+    mat[np.triu_indices(4, 1)] = rng.random(6)
+    path = tmp_path / "heat.png"
+    save_heatmap(mat, str(path))
+    assert path.exists() and path.stat().st_size > 1000

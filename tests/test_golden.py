@@ -1,0 +1,95 @@
+"""Golden fixture pinning the tokenization-adjacent evaluation contract.
+
+The PPL metric is DEFINED by the sliding-window schedule (begin/end/trg_len,
+``Qwen2-0.5B/main.py:151-156``), the -100 masking, the ``num_loss_tokens =
+valid - batch`` weighting, and the shifted-CE NLL. A silent change to any of
+them invalidates every cross-round comparison and the ±0.1-PPL target, so this
+test pins all of it against a checked-in fixture: a seeded corpus + seeded
+tiny-model per-chunk NLLs recorded at float64.
+
+Regenerate (after an INTENTIONAL metric change, never to quiet a failure):
+
+    python tests/test_golden.py --regen
+"""
+import os
+import sys
+
+import numpy as np
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "windowing_nll.npz")
+
+CASES = [
+    # (family, corpus_len, max_length, stride) — covers the steady stride tail,
+    # the full-window first chunk, and a short final tail chunk
+    ("qwen2", 200, 64, 16),
+    ("gpt_neox", 131, 48, 32),
+]
+
+
+def _compute_case(family, corpus_len, max_length, stride):
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import tiny_config, init_params, forward, nll_from_logits
+    from edgellm_tpu.eval.windowing import sliding_windows
+
+    cfg = tiny_config(family, num_layers=3, hidden_size=32, num_heads=4, vocab_size=128)
+    params = init_params(cfg, jax.random.key(7))
+    corpus = np.random.default_rng(11).integers(0, cfg.vocab_size, corpus_len)
+    schedule, nlls = [], []
+    for chunk in sliding_windows(corpus, max_length, stride):
+        schedule.append([chunk.index, chunk.begin, chunk.end, chunk.num_loss_tokens])
+        logits, _ = forward(cfg, params, jnp.asarray(chunk.input_ids))
+        nlls.append(float(nll_from_logits(logits, jnp.asarray(chunk.target_ids))))
+    return np.asarray(schedule, np.int64), np.asarray(nlls, np.float64)
+
+
+def _case_key(case):
+    return "_".join(str(c) for c in case)
+
+
+def regenerate():
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    payload = {}
+    for case in CASES:
+        schedule, nlls = _compute_case(*case)
+        payload[f"schedule_{_case_key(case)}"] = schedule
+        payload[f"nll_{_case_key(case)}"] = nlls
+    np.savez(GOLDEN_PATH, **payload)
+    print(f"wrote {GOLDEN_PATH}: "
+          f"{ {k: v.shape for k, v in payload.items()} }")
+
+
+def test_windowing_and_nll_match_golden():
+    assert os.path.exists(GOLDEN_PATH), \
+        "golden fixture missing — run: python tests/test_golden.py --regen"
+    golden = np.load(GOLDEN_PATH)
+    for case in CASES:
+        schedule, nlls = _compute_case(*case)
+        np.testing.assert_array_equal(
+            schedule, golden[f"schedule_{_case_key(case)}"],
+            err_msg=f"window schedule drifted for {case} — the PPL metric "
+                    f"definition changed")
+        # fp32 forward + fp32 CE: identical op sequence must reproduce exactly
+        # on the same backend; allow only float noise across backends
+        np.testing.assert_allclose(
+            nlls, golden[f"nll_{_case_key(case)}"], rtol=2e-6, atol=2e-6,
+            err_msg=f"per-chunk NLL drifted for {case}")
+
+
+def test_golden_covers_edge_chunks():
+    """The fixture really exercises first-window, steady, and tail chunks."""
+    golden = np.load(GOLDEN_PATH)
+    sched = golden[f"schedule_{_case_key(CASES[0])}"]
+    _, corpus_len, max_length, stride = CASES[0]
+    assert sched[0][3] == max_length - 1          # chunk 0 scores everything
+    assert sched[1][3] == stride - 1              # steady: trg_len - batch
+    assert sched[-1][2] == corpus_len             # tail reaches corpus end
+    assert sched[-1][2] - sched[-1][1] < max_length  # and is genuinely short
+
+
+if __name__ == "__main__":
+    if "--regen" in sys.argv:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        regenerate()
+    else:
+        print(__doc__)
