@@ -69,10 +69,17 @@ def main():
     elapsed = time.monotonic() - t0
     s_per_chunk = elapsed / result.chunks
 
-    # analytic FLOPs for a steady-state chunk (stride-token scoring tail)
+    # analytic FLOPs for a steady-state chunk (stride-token scoring tail);
+    # counts executed work only (the fp-baseline column is deduped across
+    # methods by the harness exactly when the codec is in DEDUP_ZERO_CODECS)
+    from edgellm_tpu.eval.harness import DEDUP_ZERO_CODECS
+
+    n_zero = (sum(1 for r in ratios if float(r) == 0.0)
+              if "int4_token_select" in DEDUP_ZERO_CODECS else 0)
     chunk_flops = token_sweep_flops_per_chunk(
         cfg, max_length, tail=stride, n_methods=len(methods),
-        layers_of_interest=layers_of_interest, n_ratios=len(ratios))
+        layers_of_interest=layers_of_interest, n_ratios=len(ratios),
+        n_zero_ratios=n_zero)
     tflops_per_s = chunk_flops / s_per_chunk / 1e12
 
     print(json.dumps({

@@ -101,6 +101,9 @@ def pairwise_layer_distances(distributions: list) -> np.ndarray:
     """Sample-averaged JS divergence between every layer pair -> (L, L) matrix,
     upper triangle filled, rest NaN (notebook cell 16)."""
     L = len(distributions)
+    if L and not distributions[0]:
+        raise ValueError("no usable samples: every corpus sample was filtered "
+                         "out before the layer-importance pass")
     results = np.full((L, L), np.nan)
     for i in range(L):
         for j in range(i + 1, L):

@@ -255,18 +255,29 @@ def run_layers(cfg: ModelConfig, params: dict, hidden: jnp.ndarray, *,
     return hidden, aux
 
 
+def _cast_params(params: dict, compute_dtype) -> dict:
+    """Cast floating params to ``compute_dtype``; None = keep as stored, so a
+    bfloat16 pytree runs the MXU's native bf16 path end-to-end (NLL math stays
+    fp32 regardless: ``unembed`` requests fp32 logits and ``_masked_ce``
+    upcasts)."""
+    if compute_dtype is None:
+        return params
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(compute_dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+
+
 def forward(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray, *,
             boundary_fn: Optional[Callable] = None,
             capture_stats: bool = False,
             collect_hidden: bool = False,
-            compute_dtype: jnp.dtype = jnp.float32):
+            compute_dtype: Optional[jnp.dtype] = None):
     """Full forward: ids -> logits (fp32), optionally with attention stats/hiddens.
 
     Mirrors the reference's manual loop (embed -> rotary -> layers -> final norm ->
     head -> logits; ``qwen_layer_wise.py:78-104``) as one jit-compiled function.
     """
-    params = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype)
-                                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    params = _cast_params(params, compute_dtype)
     hidden = embed(params, input_ids)
     hidden, aux = run_layers(cfg, params, hidden, boundary_fn=boundary_fn,
                              capture_stats=capture_stats, collect_hidden=collect_hidden)
@@ -276,13 +287,16 @@ def forward(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray, *,
 
 def run_layers_from_ids(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray, *,
                         capture_stats: bool = False,
-                        compute_dtype: jnp.dtype = jnp.float32):
+                        compute_dtype: Optional[jnp.dtype] = None):
     """Prefix pass for sweep drivers: embed -> all layers, collecting every
     post-block hidden state, WITHOUT the final norm/unembed (suffix runs redo the
     tail from a cached boundary activation, so logits here would be dead compute).
+
+    Compute dtype follows the params pytree (pass fp32 params for reference-exact
+    math; bf16 params keep the sweep on the MXU's native bf16 path) unless
+    ``compute_dtype`` overrides it.
     """
-    params = jax.tree_util.tree_map(lambda a: a.astype(compute_dtype)
-                                    if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    params = _cast_params(params, compute_dtype)
     hidden = embed(params, input_ids)
     return run_layers(cfg, params, hidden, capture_stats=capture_stats,
                       collect_hidden=True)

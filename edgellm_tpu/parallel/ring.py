@@ -31,7 +31,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.configs import ModelConfig
 from ..models.transformer import (
-    apply_rotary, embed, precompute_rope, mlp, _layernorm, _rmsnorm, _norm,
+    apply_rotary, embed, precompute_rope, mlp, unembed, _layernorm, _rmsnorm,
 )
 
 NEG_INF = -1e30  # finite mask value: keeps exp() well-defined for empty blocks
@@ -150,11 +150,7 @@ def _sp_forward(cfg: ModelConfig, mesh: Mesh, axis_name: str):
                 return _sp_block(cfg, lp, h, cos_loc, sin_loc, axis_name), None
 
             hidden, _ = jax.lax.scan(scan_body, hidden, params["layers"])
-            post = _norm(cfg, hidden, params["final_norm_scale"],
-                         params.get("final_norm_bias", 0.0))
-            head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-            return jnp.einsum("bsd,dv->bsv", post, head,
-                              preferred_element_type=jnp.float32)
+            return unembed(cfg, params, hidden)
 
         return shard_map(
             body, mesh=mesh,
@@ -206,19 +202,21 @@ class SplitRingRuntime:
 
     def __init__(self, cfg: ModelConfig, cuts, hop_codecs, mesh: Mesh):
         from .split import SplitConfig, apply_default_codec_backend
-        from ..codecs.packing import WireCodec, get_wire_codec
 
         self.cfg = cfg
         self.mesh = mesh
         self.split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(hop_codecs))
-        self.codecs = apply_default_codec_backend(
-            [c if isinstance(c, WireCodec) else get_wire_codec(c)
-             for c in self.split.hop_codecs])
+        self.codecs = apply_default_codec_backend(list(self.split.hop_codecs))
         bad = [c.name for c in self.codecs if not c.batch_invariant]
         if bad:
             raise ValueError(
                 f"stage x seq hops need per-token codecs; {bad} reduce over "
                 f"batch/sequence and would disagree across sequence shards")
+        missing = [a for a in ("stage", "seq") if a not in mesh.shape]
+        if missing:
+            raise ValueError(f"SplitRingRuntime needs a mesh with 'stage' and "
+                             f"'seq' axes (got {tuple(mesh.shape)}, missing "
+                             f"{missing}); build a ('stage', 'seq') mesh")
         if mesh.shape["stage"] != self.split.n_stages:
             raise ValueError(f"mesh has {mesh.shape['stage']} stages, split "
                              f"needs {self.split.n_stages}")
@@ -268,11 +266,7 @@ class SplitRingRuntime:
             # the shared hop protocol moves each device's local seq shard
             # (per-token codecs, so shard-local encode == full-sequence encode)
             hidden = run_pipeline_stages(n_stages, codecs, run_stage, hidden)
-            post = _norm(cfg, hidden, other["final_norm_scale"],
-                         other.get("final_norm_bias", 0.0))
-            head = other["embed"].T if cfg.tie_word_embeddings else other["lm_head"]
-            return jnp.einsum("bsd,dv->bsv", post, head,
-                              preferred_element_type=jnp.float32)
+            return unembed(cfg, other, hidden)
 
         @jax.jit
         def fn(placed, input_ids):

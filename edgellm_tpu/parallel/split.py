@@ -52,16 +52,18 @@ def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
 
 
 def apply_default_codec_backend(codecs: list) -> list:
-    """On TPU the fused Pallas kernels are the default boundary-codec
-    implementation (bit-identical to the jnp twins — tested); EDGELLM_PALLAS
-    forces substitution on (=1) or off (=0) on any backend. Shared by every
-    runtime that owns hop codecs."""
+    """Resolve hop-codec specs (names or ``WireCodec`` instances) to the
+    backend's default implementation. On TPU the fused Pallas kernels are the
+    default (bit-identical to the jnp twins — tested); EDGELLM_PALLAS forces
+    substitution on (=1) or off (=0) on any backend. Shared by every runtime
+    that owns hop codecs."""
+    codecs = [c if isinstance(c, WireCodec) else get_wire_codec(c) for c in codecs]
     flag = os.environ.get("EDGELLM_PALLAS")
     if flag == "1" or (flag is None and jax.default_backend() == "tpu"):
         from ..codecs.pallas_kernels import pallas_variant
 
         return [pallas_variant(c) or c for c in codecs]
-    return list(codecs)
+    return codecs
 
 
 def regroup_layers(layers: dict, bounds: list, stage_size: int) -> tuple:
@@ -159,8 +161,7 @@ class SplitRuntime:
         self.bounds = split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
         self.codecs: list[WireCodec] = apply_default_codec_backend(
-            [c if isinstance(c, WireCodec) else get_wire_codec(c)
-             for c in split.hop_codecs])
+            list(split.hop_codecs))
         n_model = mesh.shape["model"]
         if n_model > 1:
             bad = [(name, dim) for name, dim in

@@ -179,12 +179,19 @@ def main(argv=None) -> int:
         samples = [s for s in samples if len(s) >= 2]
         if args.max_chunks:
             samples = samples[: args.max_chunks]
+        # clipping to bucketed lengths is opt-in (params key "max_compiles"):
+        # the notebook analyzes every sample at native length, and silent
+        # clipping would change the JS values it claims to reproduce
+        max_compiles = params_json.get("max_compiles")
         dists = layer_importance_distributions(
-            cfg, params, samples, max_compiles=params_json.get("max_compiles", 4))
+            cfg, params, samples, max_compiles=max_compiles)
         matrix = pairwise_layer_distances(dists)
         artifact = {"matrix": [[None if not np.isfinite(v) else float(v) for v in row]
                                for row in matrix],
-                    "n_samples": len(samples), "model": args.model}
+                    "n_samples": len(samples), "model": args.model,
+                    "max_compiles": max_compiles,
+                    "clipped": max_compiles is not None and
+                    len({int(s.shape[0]) for s in samples}) > max_compiles}
         with open(out("layer_distances.json"), "w") as f:
             json.dump(artifact, f, indent=1)
         heatmap_path = out("layer_distances.png")

@@ -40,21 +40,27 @@ def token_sweep_flops_per_chunk(
     n_methods: int,
     layers_of_interest,
     n_ratios: int,
+    n_zero_ratios: int = 0,
 ) -> float:
     """Model FLOPs the restructured token sweep performs for ONE evaluation
     window: a full stats forward plus, per (method, layer, ratio), a layer
     suffix from the boundary and a ``tail``-position unembed.
 
-    This is the work the math requires — the honest numerator for MFU. The
+    This is the work actually executed — the honest numerator for MFU. The
     reference performs strictly more (a full forward incl. full unembed per
-    combination, ``Qwen2-0.5B/main.py:170-178``).
+    combination, ``Qwen2-0.5B/main.py:170-178``). ``n_zero_ratios``: how many
+    ratios are the fp baseline that the harness dedupes across methods (one
+    baseline suffix per layer instead of ``n_methods`` identical ones —
+    ``DEDUP_ZERO_CODECS`` in ``eval/harness.py``); pass 0 for codecs that don't
+    dedupe.
     """
     per_layer = layer_flops_per_token(cfg, seq_len)
     stats_fwd = cfg.num_layers * per_layer * seq_len
     tail = min(tail, seq_len - 1)
     suffix = 0.0
+    n_suffixes = n_methods * (n_ratios - n_zero_ratios) + min(n_zero_ratios, 1)
     for layer in layers_of_interest:
         suffix_layers = cfg.num_layers - int(layer) - 1
-        suffix += n_ratios * (suffix_layers * per_layer * seq_len
-                              + unembed_flops_per_position(cfg) * tail)
-    return stats_fwd + n_methods * suffix
+        suffix += n_suffixes * (suffix_layers * per_layer * seq_len
+                                + unembed_flops_per_position(cfg) * tail)
+    return stats_fwd + suffix
