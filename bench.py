@@ -53,10 +53,11 @@ def main():
     head_weights = rng.random((cfg.num_layers, cfg.num_heads)).astype(np.float32)
     head_weights /= head_weights.sum(axis=1, keepdims=True)
 
+    codec = "int4_token_select"  # the reference's boundary scheme
     kw = dict(
         methods=methods, layers_of_interest=layers_of_interest, ratios=ratios,
         max_length=max_length, stride=stride, head_weights=head_weights,
-        window_batch=window_batch,
+        window_batch=window_batch, codec=codec,
     )
 
     # warmup: one full untimed pass over the same chunk schedule, so every
@@ -75,7 +76,7 @@ def main():
     from edgellm_tpu.eval.harness import DEDUP_ZERO_CODECS
 
     n_zero = (sum(1 for r in ratios if float(r) == 0.0)
-              if "int4_token_select" in DEDUP_ZERO_CODECS else 0)
+              if codec in DEDUP_ZERO_CODECS else 0)
     chunk_flops = token_sweep_flops_per_chunk(
         cfg, max_length, tail=stride, n_methods=len(methods),
         layers_of_interest=layers_of_interest, n_ratios=len(ratios),
