@@ -308,6 +308,8 @@ def get_wire_codec(name: str) -> WireCodec:
         "ternary_max": lambda: _ternary("max"),
         "int4_per_token_pallas": _pallas("int4_per_token"),
         "int8_per_token_pallas": _pallas("int8_per_token"),
+        "int8_per_channel_pallas": _pallas("int8_per_channel"),
+        "int4_per_channel_pallas": _pallas("int4_per_channel"),
         "ternary_mean_pallas": _pallas("ternary_mean"),
         "ternary_max_pallas": _pallas("ternary_max"),
     }
@@ -320,4 +322,5 @@ WIRE_CODECS = ("fp32", "bf16", "fp16", "int8_per_token", "int8_per_channel",
                "int4_global", "int4_per_token", "int4_per_channel",
                "ternary_mean", "ternary_max",
                "int4_per_token_pallas", "int8_per_token_pallas",
+               "int8_per_channel_pallas", "int4_per_channel_pallas",
                "ternary_mean_pallas", "ternary_max_pallas")

@@ -22,7 +22,9 @@ Kernel inventory (each bit-identical to its jnp twin in ``packing`` — tested):
   (the gather/scatter of selected tokens stays in XLA, which lowers it to
   efficient dynamic-slice sequences; the FLOP+pack part is the kernel);
 - channel-scale ternary quantize+pack (``ternary_mean`` / ``ternary_max``;
-  the (B,S) channel-scale reduction stays in XLA).
+  the (B,S) channel-scale reduction stays in XLA);
+- channel-scale int8 quantize and int4 quantize+pack (``int8_per_channel`` /
+  ``int4_per_channel`` — the reference's 896-channel Python loop as one pass).
 
 ``pallas_wire_codec`` / ``pallas_int8_per_token`` / ``pallas_selective_int4`` /
 ``pallas_ternary`` wrap these in the
@@ -59,7 +61,12 @@ def _encode_kernel(x_ref, packed_ref, scale_ref):
 
 
 def _decode_kernel(packed_ref, scale_ref, out_ref):
-    """Inverse: unpack nibbles -> dequantize with the per-row scale."""
+    """Unpack nibbles -> dequantize. ONE body for every int4 scale granularity:
+    ``scale_ref[:]`` broadcasts a per-row (T, 1), global (1, 1), or per-channel
+    (1, D) scale block identically, so the unpack logic exists exactly once.
+    Arithmetic order matches the per-token jnp twin bit-for-bit; the per-channel
+    twin multiplies scale before the /7 (<=1 ulp apart, within the decode
+    tolerance the twin tests pin)."""
     packed = packed_ref[:].astype(jnp.int32)  # (T, D/2)
     lo = (packed & 0xF) - 8
     hi = ((packed >> 4) & 0xF) - 8
@@ -197,14 +204,6 @@ def _int4_scaled_encode_kernel(x_ref, scale_ref, packed_ref):
     packed_ref[:] = (codes[:, :half] | (codes[:, half:] << 4)).astype(jnp.uint8)
 
 
-def _int4_scaled_decode_kernel(packed_ref, scale_ref, out_ref):
-    packed = packed_ref[:].astype(jnp.int32)
-    lo = (packed & 0xF) - 8
-    hi = ((packed >> 4) & 0xF) - 8
-    codes = jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
-    out_ref[:] = codes / 7.0 * scale_ref[0, 0]
-
-
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def int4_scaled_encode_pallas(x: jnp.ndarray, scale: jnp.ndarray,
                               interpret: bool | None = None) -> jnp.ndarray:
@@ -235,7 +234,7 @@ def int4_scaled_decode_pallas(packed: jnp.ndarray, scale: jnp.ndarray,
     n, dh = packed.shape
     t = _tile(n)
     return pl.pallas_call(
-        _int4_scaled_decode_kernel,
+        _decode_kernel,
         grid=(n // t,),
         in_specs=[
             pl.BlockSpec((t, dh), lambda i: (i, 0)),
@@ -245,6 +244,110 @@ def int4_scaled_decode_pallas(packed: jnp.ndarray, scale: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((n, dh * 2), jnp.float32),
         interpret=interpret,
     )(packed, scale.reshape(1, 1).astype(jnp.float32))
+
+
+def _chan_int8_encode_kernel(x_ref, scale_ref, q_ref):
+    """Per-channel symmetric int8 quantize with provided channel scales (1, D)."""
+    q_ref[:] = jnp.round(x_ref[:] / scale_ref[:] * 127.0).astype(jnp.int8)
+
+
+def _chan_int8_decode_kernel(q_ref, scale_ref, out_ref):
+    out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[:] * jnp.float32(1.0 / 127.0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def chan_int8_encode_pallas(x: jnp.ndarray, scale: jnp.ndarray,
+                            interpret: bool | None = None) -> jnp.ndarray:
+    """(N, D) fp32 + channel scales (1, D) -> int8 codes (N, D)."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = x.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _chan_int8_encode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), jnp.int8),
+        interpret=interpret,
+    )(x.astype(jnp.float32), scale.reshape(1, -1).astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def chan_int8_decode_pallas(q: jnp.ndarray, scale: jnp.ndarray,
+                            interpret: bool | None = None) -> jnp.ndarray:
+    """Inverse of :func:`chan_int8_encode_pallas` -> (N, D) fp32."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = q.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _chan_int8_decode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), jnp.float32),
+        interpret=interpret,
+    )(q, scale.reshape(1, -1).astype(jnp.float32))
+
+
+def _chan_int4_encode_kernel(x_ref, scale_ref, packed_ref):
+    """Per-channel symmetric int4 quantize + nibble pack, channel scales (1, D).
+
+    No clip: |x| <= channel max by construction, so codes land in [-7, 7]
+    (mirrors the jnp twin ``packing._int4_per_channel`` bit-for-bit)."""
+    x = x_ref[:]
+    half = x.shape[-1] // 2
+    codes = jnp.round(x / scale_ref[:] * 7.0).astype(jnp.int32) + 8
+    packed_ref[:] = (codes[:, :half] | (codes[:, half:] << 4)).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def chan_int4_encode_pallas(x: jnp.ndarray, scale: jnp.ndarray,
+                            interpret: bool | None = None) -> jnp.ndarray:
+    """(N, D) fp32 + channel scales (1, D) -> packed (N, D/2) uint8."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = x.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _chan_int4_encode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, d // 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d // 2), jnp.uint8),
+        interpret=interpret,
+    )(x.astype(jnp.float32), scale.reshape(1, -1).astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def chan_int4_decode_pallas(packed: jnp.ndarray, scale: jnp.ndarray,
+                            interpret: bool | None = None) -> jnp.ndarray:
+    """Inverse of :func:`chan_int4_encode_pallas` -> (N, D) fp32."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, dh = packed.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _decode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, dh), lambda i: (i, 0)),
+            pl.BlockSpec((1, dh * 2), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, dh * 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, dh * 2), jnp.float32),
+        interpret=interpret,
+    )(packed, scale.reshape(1, -1).astype(jnp.float32))
 
 
 def _ternary_encode_kernel(x_ref, scale_ref, packed_ref):
@@ -375,6 +478,43 @@ def pallas_ternary(kind: str) -> WireCodec:
                      batch_invariant=False)
 
 
+def pallas_per_channel(bits: int) -> WireCodec:
+    """``int8_per_channel`` / ``int4_per_channel`` with the quantize(+pack)
+    fused; the (batch, seq) channel abs-max reduction stays in XLA (one fused
+    reduce). This is the reference's 896-iteration channel loop
+    (``qwen_layer_wise.py:125-152``) as a single kernel pass.
+
+    The int4 kernel earns its keep by fusing the nibble pack. The int8 kernel
+    is a plain elementwise op XLA fuses equally well on its own — it exists so
+    every quantizing hop codec has a kernel twin (uniform Pallas hop pipeline,
+    BASELINE.json north star), not for a fusion win."""
+
+    def encode(h):
+        b, s, d = h.shape
+        cmax = jnp.max(jnp.abs(h), axis=(0, 1), keepdims=True)
+        safe = jnp.where(cmax > 0, cmax, 1.0)
+        flat = h.reshape(b * s, d)
+        if bits == 8:
+            return {"q": chan_int8_encode_pallas(flat, safe.reshape(1, d))
+                    .reshape(b, s, d), "scale": safe}
+        return {"packed": chan_int4_encode_pallas(flat, safe.reshape(1, d))
+                .reshape(b, s, d // 2), "scale": safe}
+
+    def decode(p):
+        if bits == 8:
+            b, s, d = p["q"].shape
+            out = chan_int8_decode_pallas(p["q"].reshape(b * s, d),
+                                          p["scale"].reshape(1, d))
+            return out.reshape(b, s, d)
+        b, s, dh = p["packed"].shape
+        out = chan_int4_decode_pallas(p["packed"].reshape(b * s, dh),
+                                      p["scale"].reshape(1, dh * 2))
+        return out.reshape(b, s, dh * 2)
+
+    return WireCodec(f"int{bits}_per_channel_pallas", encode, decode,
+                     batch_invariant=False)
+
+
 def pallas_selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
     """Token-selective mixed-precision codec with the int4 low-path quantize+pack
     (and unpack+dequantize) as fused kernels.
@@ -404,6 +544,8 @@ def pallas_selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
 _PALLAS_FACTORIES = {
     "int4_per_token": pallas_wire_codec,
     "int8_per_token": pallas_int8_per_token,
+    "int8_per_channel": lambda: pallas_per_channel(8),
+    "int4_per_channel": lambda: pallas_per_channel(4),
     "ternary_mean": lambda: pallas_ternary("mean"),
     "ternary_max": lambda: pallas_ternary("max"),
 }
@@ -411,9 +553,8 @@ _PALLAS_FACTORIES = {
 
 def pallas_variant(codec: WireCodec) -> Optional[WireCodec]:
     """The Pallas-backed twin of a jnp wire codec, or None when no fused kernel
-    exists (identity casts, per-channel int codecs — pure XLA is already one
-    fused op for those). The split runtime uses this to substitute kernels on
-    TPU automatically."""
+    exists (identity casts — nothing to fuse). The split runtime uses this to
+    substitute kernels on TPU automatically."""
     if codec.name.endswith("_pallas"):
         return codec
     if codec.name in _PALLAS_FACTORIES:

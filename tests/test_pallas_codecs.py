@@ -59,7 +59,9 @@ def _assert_payload_equal(got: dict, want: dict):
             np.testing.assert_allclose(g, w, rtol=1e-7, err_msg=key)
 
 
-@pytest.mark.parametrize("name", ["int8_per_token", "ternary_mean", "ternary_max"])
+@pytest.mark.parametrize("name", ["int8_per_token", "int8_per_channel",
+                                  "int4_per_channel", "ternary_mean",
+                                  "ternary_max"])
 def test_pallas_twins_bit_identical(hidden, name):
     jnp_codec = get_wire_codec(name)
     pallas_codec = pallas_variant(jnp_codec)
