@@ -86,7 +86,10 @@ class WireCodec:
     def payload_bytes(self, hidden_shape, dtype=jnp.float32) -> int:
         spec = jax.ShapeDtypeStruct(hidden_shape, dtype)
         if self.needs_importance:
-            imp = jax.ShapeDtypeStruct((hidden_shape[1],), jnp.float32)
+            # batch > 1 implies per-row importance (per-row ordering/scale wire
+            # format — the order side channel is B x S, not S)
+            b, s = hidden_shape[0], hidden_shape[1]
+            imp = jax.ShapeDtypeStruct((s,) if b == 1 else (b, s), jnp.float32)
             return _nbytes(jax.eval_shape(self.encode, spec, imp))
         return _nbytes(jax.eval_shape(self.encode, spec))
 
@@ -235,11 +238,17 @@ def selective_int4(ratio: float, high: str = "bf16", *,
     measured ``payload_bytes`` here does not).
 
     ``encode(hidden, importance)``; the split runtime threads the importance
-    vector to importance-carrying hops.
+    vector to importance-carrying hops. ``importance`` may be a shared (S,)
+    vector (the reference's batch-1 shape — wire format unchanged) or per-row
+    (B, S): each evaluation window then carries its OWN ordering and scale,
+    exactly as the reference selects per window at batch 1
+    (``Qwen2-0.5B/main.py:161-165``), which is what makes this codec usable
+    under data-parallel window batching.
 
     ``quant_pack(low, scale)`` / ``unpack_dequant(packed, scale)`` override the
     int4 compute core (the Pallas wrapper passes its fused kernels; the wire
     format and all selection/reassembly logic stay in this one definition).
+    ``scale`` arrives as a scalar (shared path) or (B, 1, 1) (per-row path).
     """
     if not 0.0 <= ratio <= 1.0:
         raise ValueError(f"ratio must be in [0, 1], got {ratio}")
@@ -250,6 +259,21 @@ def selective_int4(ratio: float, high: str = "bf16", *,
     def encode(h, importance):
         b, s, d = h.shape
         k = int(ratio * s)
+        importance = jnp.asarray(importance)
+        if importance.ndim == 2:  # per-row ordering + scale
+            order = jnp.argsort(importance, axis=-1)  # (B, S), ascending
+            rows = jnp.arange(b)[:, None]
+            low = h[rows, order[:, :k]]  # (B, k, D)
+            max_val = (jnp.max(jnp.abs(low), axis=(1, 2)) if k
+                       else jnp.zeros((b,), jnp.float32))
+            safe = jnp.where(max_val > 0, max_val, 1.0)  # (B,)
+            return {
+                "low": (quant_pack(low, safe[:, None, None]) if k
+                        else jnp.zeros((b, 0, d // 2), jnp.uint8)),
+                "scale": safe,
+                "high": h[rows, order[:, k:]].astype(high_dtype),
+                "order": order.astype(jnp.int32),
+            }
         order = jnp.argsort(importance)  # ascending, stable — least important first
         low_idx, high_idx = order[:k], order[k:]
         low = jnp.take(h, low_idx, axis=1)  # (B, k, D)
@@ -267,10 +291,16 @@ def selective_int4(ratio: float, high: str = "bf16", *,
         k = p["low"].shape[1]
         d = p["low"].shape[2] * 2 if k else p["high"].shape[2]
         s = k + p["high"].shape[1]
-        low = unpack_dequant(p["low"], p["scale"][0]) \
-            if k else jnp.zeros((b, 0, d), jnp.float32)
         order = p["order"]
         out = jnp.zeros((b, s, d), jnp.float32)
+        if order.ndim == 2:  # per-row
+            rows = jnp.arange(b)[:, None]
+            low = unpack_dequant(p["low"], p["scale"][:, None, None]) \
+                if k else jnp.zeros((b, 0, d), jnp.float32)
+            out = out.at[rows, order[:, :k]].set(low)
+            return out.at[rows, order[:, k:]].set(p["high"].astype(jnp.float32))
+        low = unpack_dequant(p["low"], p["scale"][0]) \
+            if k else jnp.zeros((b, 0, d), jnp.float32)
         out = out.at[:, order[:k], :].set(low)
         return out.at[:, order[k:], :].set(p["high"].astype(jnp.float32))
 

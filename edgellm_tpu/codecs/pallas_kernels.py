@@ -196,10 +196,11 @@ def int8_affine_decode_pallas(q: jnp.ndarray, scale: jnp.ndarray, mn: jnp.ndarra
 
 
 def _int4_scaled_encode_kernel(x_ref, scale_ref, packed_ref):
-    """int4 quantize + pack with a provided broadcast scale (scalar block)."""
+    """int4 quantize + pack with a provided scale block — broadcasts a global
+    (1, 1) or per-row (T, 1) scale identically (one body for both)."""
     x = x_ref[:]
     half = x.shape[-1] // 2
-    safe = scale_ref[0, 0]
+    safe = scale_ref[:]
     codes = jnp.round(jnp.clip(x / safe * 7.0, -8.0, 7.0)).astype(jnp.int32) + 8
     packed_ref[:] = (codes[:, :half] | (codes[:, half:] << 4)).astype(jnp.uint8)
 
@@ -223,6 +224,28 @@ def int4_scaled_encode_pallas(x: jnp.ndarray, scale: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((n, d // 2), jnp.uint8),
         interpret=interpret,
     )(x.astype(jnp.float32), scale.reshape(1, 1).astype(jnp.float32))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int4_rowscaled_encode_pallas(x: jnp.ndarray, scale: jnp.ndarray,
+                                 interpret: bool | None = None) -> jnp.ndarray:
+    """(N, D) fp32 + per-row scales (N, 1) -> packed (N, D/2) uint8 (same
+    kernel body as the global-scale variant; the scale block is per-row)."""
+    if interpret is None:
+        interpret = _use_interpret()
+    n, d = x.shape
+    t = _tile(n)
+    return pl.pallas_call(
+        _int4_scaled_encode_kernel,
+        grid=(n // t,),
+        in_specs=[
+            pl.BlockSpec((t, d), lambda i: (i, 0)),
+            pl.BlockSpec((t, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((t, d // 2), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d // 2), jnp.uint8),
+        interpret=interpret,
+    )(x.astype(jnp.float32), scale.reshape(-1, 1).astype(jnp.float32))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -529,11 +552,21 @@ def pallas_selective_int4(ratio: float, high: str = "bf16") -> WireCodec:
 
     def quant_pack(low, safe):
         b, k, d = low.shape
+        safe = jnp.asarray(safe)
+        if safe.size > 1:  # per-row (B, 1, 1) scales -> one scale per flat row
+            rows = jnp.broadcast_to(safe.reshape(b, 1), (b, k)).reshape(b * k, 1)
+            return int4_rowscaled_encode_pallas(low.reshape(b * k, d), rows) \
+                .reshape(b, k, d // 2)
         return int4_scaled_encode_pallas(low.reshape(b * k, d), safe) \
             .reshape(b, k, d // 2)
 
     def unpack_dequant(packed, safe):
         b, k, dh = packed.shape
+        safe = jnp.asarray(safe)
+        if safe.size > 1:  # per-row scales: the shared decode kernel broadcasts
+            rows = jnp.broadcast_to(safe.reshape(b, 1), (b, k)).reshape(b * k, 1)
+            return int4_decode_pallas(packed.reshape(b * k, dh), rows) \
+                .reshape(b, k, dh * 2)
         return int4_scaled_decode_pallas(packed.reshape(b * k, dh), safe) \
             .reshape(b, k, dh * 2)
 

@@ -176,7 +176,11 @@ class SplitRuntime:
             raise ValueError(
                 f"mesh has {mesh.shape['stage']} stage slots, split needs {n_stages}")
         if mesh.shape["data"] > 1:
-            bad = [c.name for c in self.codecs if not c.batch_invariant]
+            # token-selective codecs are exempt: ``forward`` forces per-row
+            # (B, S) importance for batched windows, making their ordering and
+            # scale row-local — identical on any batch sharding
+            bad = [c.name for c in self.codecs
+                   if not c.batch_invariant and not c.needs_importance]
             if bad:
                 raise ValueError(
                     f"codecs {bad} compute scales over the batch axis and would "
@@ -265,10 +269,14 @@ class SplitRuntime:
             hidden = embed(placed, input_ids)
             cos, sin = precompute_rope(cfg, input_ids.shape[1])
             lspecs = {k: layer_pspec(k, v.ndim) for k, v in placed["layers"].items()}
+            # per-row (H, B, S) importance rides the "data" axis with the batch;
+            # shared (H, S) importance is replicated (ndim is static under jit)
+            imp_spec = (P(None, "data") if hop_imps.ndim == 3
+                        and mesh.shape["data"] > 1 else P())
             out = shard_map(
                 stage_body,
                 mesh=mesh,
-                in_specs=(lspecs, P("stage"), batch_spec, P(), P(), P()),
+                in_specs=(lspecs, P("stage"), batch_spec, P(), P(), imp_spec),
                 out_specs=batch_spec,
                 # vma tracking cannot type pallas_call outputs inside the body
                 # (hop codecs may be Pallas kernels); replication is enforced
@@ -283,30 +291,37 @@ class SplitRuntime:
                 hop_importance: Optional[Sequence] = None) -> jnp.ndarray:
         """ids -> fp32 logits, with every cut crossed as a packed ppermute.
 
-        ``hop_importance``: per-hop (S,) token-importance vectors, required when
-        any hop codec is token-selective (``needs_importance``); hops that don't
-        use importance may pass None entries."""
+        ``hop_importance``: per-hop token-importance entries, required when any
+        hop codec is token-selective (``needs_importance``); hops that don't
+        use importance may pass None entries. Each entry is (S,), or — when
+        batching evaluation windows — (B, S) so every window keeps its OWN
+        ordering and codec scale (the reference selects per window at batch 1,
+        ``Qwen2-0.5B/main.py:161-165``; with the "data" mesh axis populated the
+        rows ride it alongside the hidden batch)."""
         n_hops = len(self.codecs)
-        seq = input_ids.shape[1]
+        batch, seq = input_ids.shape
         imps = list(hop_importance) if hop_importance is not None else [None] * n_hops
         if len(imps) != n_hops:
             raise ValueError(f"expected {n_hops} hop_importance entries, got {len(imps)}")
-        needs = [c.needs_importance for c in self.codecs]
-        if any(needs) and input_ids.shape[0] > 1:
-            # one (S,) importance vector cannot speak for several evaluation
-            # windows: each window has its own token ordering in the reference
-            # (Qwen2-0.5B/main.py:161-165); silently sharing one would diverge
-            raise ValueError(
-                f"token-selective hop codecs "
-                f"{[c.name for c, n in zip(self.codecs, needs) if n]} take one "
-                f"importance vector per forward; run batch=1 windows (got batch "
-                f"{input_ids.shape[0]})")
         for c, imp in zip(self.codecs, imps):
             if c.needs_importance and imp is None:
                 raise ValueError(f"hop codec {c.name} requires an importance vector")
-        stacked = (jnp.zeros((0, seq), jnp.float32) if not imps else
-                   jnp.stack([jnp.zeros(seq, jnp.float32) if i is None
-                              else jnp.asarray(i, jnp.float32) for i in imps]))
+            if c.needs_importance and batch > 1 and (
+                    jnp.ndim(imp) != 2 or jnp.shape(imp)[0] != batch):
+                # one (S,) vector (or a single broadcast row) cannot speak for
+                # several evaluation windows: each window has its own token
+                # ordering in the reference
+                raise ValueError(
+                    f"hop codec {c.name} with batch {batch} needs per-row "
+                    f"({batch}, S) importance (got shape {jnp.shape(imp)})")
+        per_row = any(i is not None and jnp.ndim(i) == 2 for i in imps) or (
+            batch > 1 and any(c.needs_importance for c in self.codecs))
+        blank = jnp.zeros((batch, seq) if per_row else (seq,), jnp.float32)
+        stacked = (jnp.zeros((0,) + blank.shape, jnp.float32) if not imps else
+                   jnp.stack([blank if i is None
+                              else jnp.broadcast_to(jnp.asarray(i, jnp.float32),
+                                                    blank.shape)
+                              for i in imps]))
         return self._forward(placed_params, input_ids, stacked)
 
     # ---------- accounting ----------
@@ -332,7 +347,10 @@ class SplitRuntime:
         mesh = self.mesh
         hidden = jax.random.normal(
             jax.random.key(0), (batch, seq, self.cfg.hidden_size), jnp.float32)
-        imp = jnp.arange(seq, dtype=jnp.float32)
+        # match forward's wire format: batched windows ship per-row importance
+        # (B x S order side channel), so time that payload, not the shared one
+        imp = (jnp.arange(seq, dtype=jnp.float32) if batch == 1 else
+               jnp.broadcast_to(jnp.arange(seq, dtype=jnp.float32), (batch, seq)))
         for s, codec in enumerate(self.codecs):
 
             def hop_body(h):

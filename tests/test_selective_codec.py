@@ -87,3 +87,83 @@ def test_split_runtime_missing_importance_raises(data):
 def test_invalid_ratio_raises():
     with pytest.raises(ValueError):
         selective_int4(1.5)
+
+
+@pytest.mark.parametrize("ratio", [0.25, 0.5])
+def test_per_row_importance_matches_independent_rows(rng, ratio):
+    """(B, S) importance: every row gets its own ordering AND scale — identical
+    to encoding each row separately with its own (S,) vector."""
+    h = jnp.asarray(rng.normal(size=(3, 16, 32)).astype(np.float32))
+    imp = jnp.asarray(rng.random((3, 16)).astype(np.float32))
+    codec = selective_int4(ratio, high="fp32")
+    batched = np.asarray(codec.decode(codec.encode(h, imp)))
+    for b in range(3):
+        single = np.asarray(codec.decode(codec.encode(h[b:b + 1], imp[b])))
+        np.testing.assert_array_equal(batched[b:b + 1], single)
+
+
+def test_per_row_payload_counts_batched_order():
+    D, S, B = 64, 16, 4
+    codec = selective_int4(0.5, high="bf16")
+    one = codec.payload_bytes((1, S, D))
+    four = codec.payload_bytes((B, S, D))
+    # per-row wire format: order side channel and scales scale with B
+    assert four == B * (one - 4) + B * 4
+
+
+def test_pallas_per_row_matches_jnp(rng):
+    from edgellm_tpu.codecs.pallas_kernels import pallas_selective_int4
+
+    h = jnp.asarray(rng.normal(size=(3, 16, 32)).astype(np.float32))
+    imp = jnp.asarray(rng.random((3, 16)).astype(np.float32))
+    j = selective_int4(0.5, "bf16")
+    pc = pallas_selective_int4(0.5, "bf16")
+    want, got = j.encode(h, imp), pc.encode(h, imp)
+    for key in want:
+        np.testing.assert_array_equal(np.asarray(got[key]), np.asarray(want[key]),
+                                      err_msg=key)
+    np.testing.assert_allclose(np.asarray(pc.decode(got)),
+                               np.asarray(j.decode(want)), atol=1e-6)
+
+
+def test_split_runtime_per_row_importance_data_parallel(rng):
+    """Batched windows + selective hop: per-row (B, S) importance through the
+    split runtime over ("stage", "data") equals the per-window batch-1 runs."""
+    params = init_params(CFG, jax.random.key(1))
+    ids = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 16)))
+    imp = jnp.asarray(rng.random((2, 16)).astype(np.float32))
+    cut, ratio = 2, 0.5
+    codec = selective_int4(ratio, "fp32")
+
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(cut,), hop_codecs=(codec,)),
+                      make_stage_mesh(2, n_data=2))
+    out = np.asarray(rt.forward(rt.place_params(params), ids, hop_importance=[imp]))
+
+    rt1 = SplitRuntime(CFG, SplitConfig(cuts=(cut,), hop_codecs=(codec,)),
+                       make_stage_mesh(2))
+    placed1 = rt1.place_params(params)
+    for b in range(2):
+        want = np.asarray(rt1.forward(placed1, ids[b:b + 1],
+                                      hop_importance=[imp[b]]))
+        np.testing.assert_allclose(out[b:b + 1], want, atol=2e-5, rtol=2e-5)
+
+
+def test_split_runtime_batch_without_per_row_importance_raises(rng):
+    params = init_params(CFG, jax.random.key(1))
+    ids = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 16)))
+    imp = jnp.asarray(rng.random(16).astype(np.float32))
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(2,), hop_codecs=(selective_int4(0.5),)),
+                      make_stage_mesh(2))
+    with pytest.raises(ValueError, match="per-row"):
+        rt.forward(rt.place_params(params), ids, hop_importance=[imp])
+
+
+def test_split_runtime_broadcast_row_importance_raises(rng):
+    """A (1, S) importance at batch > 1 must be rejected, not silently shared."""
+    params = init_params(CFG, jax.random.key(1))
+    ids = jnp.asarray(rng.integers(0, CFG.vocab_size, (4, 16)))
+    imp = jnp.asarray(rng.random((1, 16)).astype(np.float32))
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(2,), hop_codecs=(selective_int4(0.5),)),
+                      make_stage_mesh(2))
+    with pytest.raises(ValueError, match=r"\(4, S\)"):
+        rt.forward(rt.place_params(params), ids, hop_importance=[imp])
