@@ -171,13 +171,20 @@ def _suffix_sweep(cfg: ModelConfig, layer: int, codec: str, tail: int):
 
 @functools.lru_cache(maxsize=None)
 def _suffix_channel(cfg: ModelConfig, layer: int, method: str, tail: int):
-    """Jitted: boundary hidden -> NLL under one per-channel codec."""
+    """Jitted: boundary hiddens -> per-window NLL under one per-channel codec.
+
+    Windows are vmapped with the codec INSIDE the per-window function, so each
+    window keeps its own channel scales — identical to the reference's batch-1
+    sweep (``channel_wise.py:35-49``), W windows per executable."""
 
     @jax.jit
-    def fn(params, boundary_hidden, targets):
-        h = channel_wise_quant(boundary_hidden, method)
-        out, _ = run_layers(cfg, params, h, start=layer + 1)
-        return nll_tail(cfg, params, out, targets, tail)
+    def fn(params, boundary_hidden, targets):  # (W, S, D), (W, S) -> (W,)
+        def per_window(h_w, tgt_w):
+            h = channel_wise_quant(h_w[None], method)
+            out, _ = run_layers(cfg, params, h, start=layer + 1)
+            return nll_tail(cfg, params, out, tgt_w[None], tail)
+
+        return jax.vmap(per_window)(boundary_hidden, targets)
 
     return fn
 
@@ -243,6 +250,46 @@ class SweepResult:
         lines.append(f"[{self.chunks} chunks, {self.n_tokens:.0f} scored tokens, "
                      f"{self.wall_s:.1f}s, weighting={self.weighting}]")
         return "\n".join(lines)
+
+
+def _iter_window_groups(token_ids, max_length: int, stride: int, *,
+                        window_batch: int, start_chunk: int = 0,
+                        max_count: Optional[int] = None, tail_of=None):
+    """Yield groups of evaluation windows for one batched executable each.
+
+    Only full-length windows are grouped (the short corpus-tail window runs
+    singly); ``tail_of`` further splits groups whose scoring-tail lengths
+    differ — chunk 0 scores the whole window and batching it with stride-tail
+    chunks would force the group's unembed to the full window for every member,
+    a W-fold blowup of the logits buffer. ``start_chunk`` skips resumed chunks;
+    ``max_count`` caps the total yielded. Shared by all sweep drivers.
+    """
+    buffer: list = []
+    yielded = 0
+    for chunk in sliding_windows(token_ids, max_length, stride):
+        if chunk.index < start_chunk:
+            continue
+        if max_count is not None and yielded + len(buffer) >= max_count:
+            break
+        if chunk.input_ids.shape[1] == max_length and window_batch > 1:
+            if buffer and tail_of is not None and tail_of(chunk) != tail_of(buffer[0]):
+                yield buffer
+                yielded += len(buffer)
+                buffer = []
+            buffer.append(chunk)
+            if len(buffer) == window_batch:
+                yield buffer
+                yielded += len(buffer)
+                buffer = []
+        else:
+            if buffer:
+                yield buffer
+                yielded += len(buffer)
+                buffer = []
+            yield [chunk]
+            yielded += 1
+    if buffer:
+        yield buffer
 
 
 def _load_checkpoint(path: Optional[str], axes: dict) -> Optional[dict]:
@@ -381,32 +428,13 @@ def run_token_sweep(
             _emit(metrics_path, {"chunk": group[-1].index, "n_tokens": result.n_tokens,
                                  "ppl": result.ppl().tolist()})
 
-    # windows are grouped only when they share shape AND scoring-tail length:
-    # chunk 0 scores the whole window (trg_len = max_length) and batching it
-    # with stride-tail chunks would force the group's unembed to the full
-    # window for every member — a W-fold blowup of the logits buffer
     tail_of = lambda c: min(c.num_loss_tokens + 1, c.input_ids.shape[1] - 1)
-    buffer = []
-    for chunk in sliding_windows(token_ids, max_length, stride):
-        if chunk.index < start_chunk:
-            continue
-        if max_chunks is not None and result.chunks + len(buffer) >= max_chunks:
-            break
-        if chunk.input_ids.shape[1] == max_length and window_batch > 1:
-            if buffer and tail_of(chunk) != tail_of(buffer[0]):
-                process_group(buffer)
-                buffer = []
-            buffer.append(chunk)
-            if len(buffer) == window_batch:
-                process_group(buffer)
-                buffer = []
-        else:
-            if buffer:
-                process_group(buffer)
-                buffer = []
-            process_group([chunk])
-    if buffer:
-        process_group(buffer)
+    remaining = None if max_chunks is None else max_chunks - result.chunks
+    for group in _iter_window_groups(token_ids, max_length, stride,
+                                     window_batch=window_batch,
+                                     start_chunk=start_chunk,
+                                     max_count=remaining, tail_of=tail_of):
+        process_group(group)
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,
@@ -429,6 +457,7 @@ def run_initial_sweep(
     checkpoint_every: int = 1000,
     metrics_path: Optional[str] = None,
     max_chunks: Optional[int] = None,
+    window_batch: int = 1,
 ) -> SweepResult:
     """The Pythia "initial" experiment (``initial_exp.py:74-137``).
 
@@ -462,34 +491,40 @@ def run_initial_sweep(
     stats_fn = _stats_forward(cfg)
     t0 = time.monotonic()
     next_chunk = start_chunk
+    last_ckpt = result.chunks
+    tail_of = lambda c: min(c.num_loss_tokens + 1, c.input_ids.shape[1] - 1)
+    remaining = None if max_chunks is None else max_chunks - result.chunks
 
-    for chunk in sliding_windows(token_ids, max_length, stride):
-        if chunk.index < start_chunk:
-            continue
-        if max_chunks is not None and result.chunks >= max_chunks:
-            break
-        ids, targets = jnp.asarray(chunk.input_ids), jnp.asarray(chunk.target_ids)
+    for group in _iter_window_groups(token_ids, max_length, stride,
+                                     window_batch=window_batch,
+                                     start_chunk=start_chunk,
+                                     max_count=remaining, tail_of=tail_of):
+        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
+        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
+        tail = max(c.num_loss_tokens + 1 for c in group)
         ks = jnp.asarray([int(0.1 * r * ids.shape[1]) for r in ratios], jnp.int32)
         stats, hiddens = stats_fn(params, ids)
-        next_chunk = chunk.index + 1
-        reg = regular_importance(stats.col_mean)  # (L, B, S)
+        next_chunk = group[-1].index + 1
+        reg = regular_importance(stats.col_mean)  # (L, W, S)
         for l, spec in enumerate(layers_of_interest):
             if spec == "aggregate upto 2":
-                imp, codec = aggregate_upto(stats.col_mean, 2)[0], "affine_int8_rank"
+                imp, codec = aggregate_upto(stats.col_mean, 2), "affine_int8_rank"
             elif spec == "maximum aggregation":
-                imp, codec = maximum_aggregation(stats.col_mean, 2)[0], "affine_int8_rank"
+                imp, codec = maximum_aggregation(stats.col_mean, 2), "affine_int8_rank"
             elif spec == "upto ratio":
-                imp, codec = reg[quant_layer, 0], "affine_int8_top_rho"
+                imp, codec = reg[quant_layer], "affine_int8_top_rho"
             else:
-                imp, codec = reg[int(spec), 0], "affine_int8_rank"
-            nlls = _suffix_sweep(cfg, quant_layer, codec, chunk.num_loss_tokens + 1)(
-                params, hiddens[quant_layer], targets, imp[None], fracs, ks)  # (R, 1)
-            result.total_nll[l] += np.asarray(nlls)[:, 0]
-        result.n_tokens += chunk.num_loss_tokens
-        result.chunks += 1
-        if result.chunks % checkpoint_every == 0:
-            _save_checkpoint(checkpoint_path, result, chunk.index + 1)
-            _emit(metrics_path, {"chunk": chunk.index, "ppl": result.ppl().tolist()})
+                imp, codec = reg[int(spec)], "affine_int8_rank"
+            nlls = _suffix_sweep(cfg, quant_layer, codec, tail)(
+                params, hiddens[quant_layer], targets, imp, fracs, ks)  # (R, W)
+            # unweighted mean-of-chunk-means: each window contributes equally
+            result.total_nll[l] += np.asarray(nlls, np.float64).sum(axis=1)
+        result.n_tokens += sum(c.num_loss_tokens for c in group)
+        result.chunks += len(group)
+        if result.chunks - last_ckpt >= checkpoint_every:
+            last_ckpt = result.chunks
+            _save_checkpoint(checkpoint_path, result, next_chunk)
+            _emit(metrics_path, {"chunk": group[-1].index, "ppl": result.ppl().tolist()})
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,
@@ -510,9 +545,11 @@ def run_channel_sweep(
     checkpoint_every: int = 1000,
     metrics_path: Optional[str] = None,
     max_chunks: Optional[int] = None,
+    window_batch: int = 1,
 ) -> SweepResult:
     """Per-channel codec sweep (``channel_wise.py:10-78``): methods x layers,
-    token-weighted NLL, no importance scoring."""
+    token-weighted NLL, no importance scoring. ``window_batch`` groups
+    evaluation windows into one executable (per-window channel scales kept)."""
     bad = [l for l in layers_of_interest if not 0 <= int(l) < cfg.num_layers]
     if bad:
         raise ValueError(f"layers_of_interest {bad} out of range for a "
@@ -530,25 +567,30 @@ def run_channel_sweep(
     fwd = _plain_forward(cfg)
     t0 = time.monotonic()
     next_chunk = start_chunk
-    for chunk in sliding_windows(token_ids, max_length, stride):
-        if chunk.index < start_chunk:
-            continue
-        if max_chunks is not None and result.chunks >= max_chunks:
-            break
-        ids, targets = jnp.asarray(chunk.input_ids), jnp.asarray(chunk.target_ids)
-        hiddens = fwd(params, ids)
-        next_chunk = chunk.index + 1
+    last_ckpt = result.chunks
+    tail_of = lambda c: min(c.num_loss_tokens + 1, c.input_ids.shape[1] - 1)
+    remaining = None if max_chunks is None else max_chunks - result.chunks
+    for group in _iter_window_groups(token_ids, max_length, stride,
+                                     window_batch=window_batch,
+                                     start_chunk=start_chunk,
+                                     max_count=remaining, tail_of=tail_of):
+        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
+        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
+        counts = np.array([c.num_loss_tokens for c in group], np.float64)
+        tail = max(c.num_loss_tokens + 1 for c in group)
+        hiddens = fwd(params, ids)  # (L, W, S, D)
+        next_chunk = group[-1].index + 1
         for m, method in enumerate(methods):
             for l, layer in enumerate(layers_of_interest):
-                nll = _suffix_channel(cfg, int(layer), method,
-                                      chunk.num_loss_tokens + 1)(
-                    params, hiddens[layer], targets)
-                result.total_nll[m, l] += float(nll) * chunk.num_loss_tokens
-        result.n_tokens += chunk.num_loss_tokens
-        result.chunks += 1
-        if result.chunks % checkpoint_every == 0:
-            _save_checkpoint(checkpoint_path, result, chunk.index + 1)
-            _emit(metrics_path, {"chunk": chunk.index, "ppl": result.ppl().tolist()})
+                nlls = _suffix_channel(cfg, int(layer), method, tail)(
+                    params, hiddens[layer], targets)  # (W,)
+                result.total_nll[m, l] += np.asarray(nlls, np.float64) @ counts
+        result.n_tokens += counts.sum()
+        result.chunks += len(group)
+        if result.chunks - last_ckpt >= checkpoint_every:
+            last_ckpt = result.chunks
+            _save_checkpoint(checkpoint_path, result, next_chunk)
+            _emit(metrics_path, {"chunk": group[-1].index, "ppl": result.ppl().tolist()})
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,

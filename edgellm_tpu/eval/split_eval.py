@@ -26,7 +26,6 @@ from ..models.transformer import nll_from_logits, run_layers_from_ids
 from ..importance import importance_per_layer
 from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
 from ..codecs.packing import WireCodec, selective_int4
-from .windowing import sliding_windows
 
 
 def parse_hop_codec(spec: str) -> object:
@@ -74,6 +73,7 @@ def run_split_eval(
     max_chunks: Optional[int] = None,
     progress=None,
     time_hops: bool = True,
+    window_batch: int = 1,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
 
@@ -81,6 +81,14 @@ def run_split_eval(
     instances. Token-selective hops take their importance from
     ``importance_method`` (computed at the hop's cut layer by a stats pass —
     the same scores the simulate harness uses).
+
+    ``window_batch``: run up to W full-length evaluation windows through the
+    pipeline as one batch (identical accumulation — per-row NLL weighting, and
+    token-selective hops carry per-row importance so every window keeps its own
+    ordering and scale). With the mesh's "data" axis populated the batch is
+    additionally sharded across it; a final partial group is padded up to the
+    axis size with repeated windows whose loss weight is zero (the padding does
+    cross the wire and is counted in the pushed-token/byte totals).
     """
     codecs = [parse_hop_codec(c) if isinstance(c, str) else c for c in hop_codecs]
     split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(codecs))
@@ -95,34 +103,56 @@ def run_split_eval(
     imp_fn = (_importance_fn(cfg, importance_method)
               if any(needs_imp) and importance_method is not None else None)
     hw = None if head_weights is None else jnp.asarray(head_weights)
+    n_data = mesh.shape["data"]
+    if window_batch % n_data:
+        raise ValueError(f"window_batch {window_batch} must be a multiple of the "
+                         f"mesh data axis size {n_data}")
 
     total_nll, n_tokens, chunks = 0.0, 0.0, 0
-    fwd_tokens = 0  # every token pushed through the pipeline (incl. overlap)
+    fwd_tokens = 0  # every token pushed through the pipeline (incl. overlap/pad)
     hop_bytes_total = [0] * len(rt.codecs)  # measured per chunk, tail included
     bytes_cache: dict = {}
     t0 = time.monotonic()
-    for chunk in sliding_windows(token_ids, max_length, stride):
-        if max_chunks is not None and chunks >= max_chunks:
-            break
-        ids = jnp.asarray(chunk.input_ids)
+
+    def process_group(group):
+        nonlocal total_nll, n_tokens, chunks, fwd_tokens
+        n_real = len(group)
+        counts = [c.num_loss_tokens for c in group]
+        # pad a partial group up to the data-axis size with repeated windows;
+        # their loss weight is zero
+        while len(group) % n_data:
+            group = group + [group[-1]]
+            counts = counts + [0]
+        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
+        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
         hop_imp = None
         if imp_fn is not None:
-            imp = imp_fn(params, ids, hw)  # (L, B, S)
-            hop_imp = [imp[cut, 0] if need else None
+            imp = imp_fn(params, ids, hw)  # (L, W, S)
+            hop_imp = [(imp[cut] if len(group) > 1 else imp[cut, 0]) if need
+                       else None
                        for cut, need in zip(split.cuts, needs_imp)]
         logits = rt.forward(placed, ids, hop_importance=hop_imp)
-        nll = float(nll_from_logits(logits, jnp.asarray(chunk.target_ids)))
-        total_nll += nll * chunk.num_loss_tokens
-        n_tokens += chunk.num_loss_tokens
-        s_chunk = int(ids.shape[1])
-        fwd_tokens += s_chunk
-        if s_chunk not in bytes_cache:  # payloads are shape-determined
-            bytes_cache[s_chunk] = rt.hop_bytes(1, s_chunk)
-        for i, b in enumerate(bytes_cache[s_chunk]):
+        nlls = np.asarray(nll_from_logits(logits, targets, per_example=True),
+                          np.float64)
+        total_nll += float(nlls @ np.asarray(counts, np.float64))
+        n_tokens += sum(counts)
+        w, s_chunk = ids.shape
+        fwd_tokens += w * s_chunk
+        key = (w, s_chunk)
+        if key not in bytes_cache:  # payloads are shape-determined
+            bytes_cache[key] = rt.hop_bytes(w, s_chunk)
+        for i, b in enumerate(bytes_cache[key]):
             hop_bytes_total[i] += b
-        chunks += 1
+        chunks += n_real
         if progress:
-            progress(chunk.index)
+            progress(group[-1].index)
+
+    from .harness import _iter_window_groups
+
+    for group in _iter_window_groups(token_ids, max_length, stride,
+                                     window_batch=window_batch,
+                                     max_count=max_chunks):
+        process_group(group)
     wall = time.monotonic() - t0
 
     seq = min(max_length, len(np.asarray(token_ids).reshape(-1)))

@@ -1,4 +1,5 @@
-from .configs import ModelConfig, PYTHIA_70M, QWEN2_0_5B, QWEN2_1_5B, PRESETS, tiny_config
+from .configs import (ModelConfig, PYTHIA_70M, QWEN2_0_5B, QWEN2_1_5B,
+                      LLAMA_3_2_1B, PRESETS, tiny_config)
 from .transformer import (
     AttnStats, forward, run_layers, embed, unembed, nll_from_logits, init_params,
     precompute_rope,
@@ -6,7 +7,8 @@ from .transformer import (
 from .hf_loader import params_from_state_dict, config_from_hf
 
 __all__ = [
-    "ModelConfig", "PYTHIA_70M", "QWEN2_0_5B", "QWEN2_1_5B", "PRESETS", "tiny_config",
+    "ModelConfig", "PYTHIA_70M", "QWEN2_0_5B", "QWEN2_1_5B", "LLAMA_3_2_1B",
+    "PRESETS", "tiny_config",
     "AttnStats", "forward", "run_layers", "embed", "unembed", "nll_from_logits",
     "init_params", "precompute_rope", "params_from_state_dict", "config_from_hf",
 ]

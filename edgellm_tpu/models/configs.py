@@ -22,6 +22,8 @@ class ModelConfig:
         partial rotary (``rotary_pct``), biases on all linears. Pythia models.
       - ``"qwen2"``: sequential-residual blocks, RMSNorm, SwiGLU MLP, full rotary,
         QKV biases but bias-free o/gate/up/down projections, grouped-query attention.
+      - ``"llama"``: identical wiring to qwen2 with no biases anywhere
+        (Llama-2/3 models; beyond the reference's two families).
     """
 
     family: str
@@ -45,8 +47,12 @@ class ModelConfig:
     def rotary_dim(self) -> int:
         return int(self.head_dim * self.rotary_pct)
 
+    @property
+    def qkv_bias(self) -> bool:
+        return self.family in ("gpt_neox", "qwen2")
+
     def __post_init__(self):
-        if self.family not in ("gpt_neox", "qwen2"):
+        if self.family not in ("gpt_neox", "qwen2", "llama"):
             raise ValueError(f"unknown family: {self.family}")
         if self.hidden_size % self.num_heads:
             raise ValueError("num_heads must evenly divide hidden_size")
@@ -101,13 +107,28 @@ QWEN2_1_5B = ModelConfig(
     tie_word_embeddings=True,
 )
 
+# meta-llama/Llama-3.2-1B — beyond-parity family (edge-sized Llama).
+LLAMA_3_2_1B = ModelConfig(
+    family="llama",
+    vocab_size=128256,
+    hidden_size=2048,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    intermediate_size=8192,
+    max_position_embeddings=131072,
+    norm_eps=1e-5,
+    rope_theta=500000.0,
+    tie_word_embeddings=True,
+)
+
 
 def tiny_config(family: str, *, num_layers: int = 4, hidden_size: int = 64,
                 num_heads: int = 4, num_kv_heads: int | None = None,
                 vocab_size: int = 256, intermediate_size: int | None = None) -> ModelConfig:
     """Small random-init config for tests (no pretrained weights in this environment)."""
     if num_kv_heads is None:
-        num_kv_heads = 2 if family == "qwen2" else num_heads
+        num_kv_heads = 2 if family in ("qwen2", "llama") else num_heads
     if intermediate_size is None:
         intermediate_size = hidden_size * 4
     return ModelConfig(
@@ -122,7 +143,7 @@ def tiny_config(family: str, *, num_layers: int = 4, hidden_size: int = 64,
         norm_eps=1e-5 if family == "gpt_neox" else 1e-6,
         rope_theta=10000.0 if family == "gpt_neox" else 1000000.0,
         rotary_pct=0.25 if family == "gpt_neox" else 1.0,
-        tie_word_embeddings=family == "qwen2",
+        tie_word_embeddings=family in ("qwen2", "llama"),
     )
 
 
@@ -130,7 +151,9 @@ PRESETS = {
     "pythia-70m": PYTHIA_70M,
     "qwen2-0.5b": QWEN2_0_5B,
     "qwen2-1.5b": QWEN2_1_5B,
+    "llama-3.2-1b": LLAMA_3_2_1B,
     # CI/smoke-scale variants (random init, no pretrained weights needed)
     "tiny-neox": tiny_config("gpt_neox"),
     "tiny-qwen2": tiny_config("qwen2", num_layers=6),
+    "tiny-llama": tiny_config("llama", num_layers=6),
 }

@@ -91,15 +91,13 @@ def _neox_params(cfg: ModelConfig, sd: dict) -> dict:
 
 
 def _qwen2_params(cfg: ModelConfig, sd: dict) -> dict:
+    """Qwen2 and Llama share HF key names; Llama simply has no QKV biases."""
     L = cfg.num_layers
     lt = "model.layers.{i}."
     layers = {
         "wq": _stack(sd, lt + "self_attn.q_proj.weight", L, lambda w: w.T),
         "wk": _stack(sd, lt + "self_attn.k_proj.weight", L, lambda w: w.T),
         "wv": _stack(sd, lt + "self_attn.v_proj.weight", L, lambda w: w.T),
-        "bq": _stack(sd, lt + "self_attn.q_proj.bias", L, lambda b: b),
-        "bk": _stack(sd, lt + "self_attn.k_proj.bias", L, lambda b: b),
-        "bv": _stack(sd, lt + "self_attn.v_proj.bias", L, lambda b: b),
         "wo": _stack(sd, lt + "self_attn.o_proj.weight", L, lambda w: w.T),
         "ln1_scale": _stack(sd, lt + "input_layernorm.weight", L, lambda w: w),
         "ln2_scale": _stack(sd, lt + "post_attention_layernorm.weight", L, lambda w: w),
@@ -107,6 +105,12 @@ def _qwen2_params(cfg: ModelConfig, sd: dict) -> dict:
         "w_up": _stack(sd, lt + "mlp.up_proj.weight", L, lambda w: w.T),
         "w_down": _stack(sd, lt + "mlp.down_proj.weight", L, lambda w: w.T),
     }
+    if cfg.qkv_bias:
+        layers.update({
+            "bq": _stack(sd, lt + "self_attn.q_proj.bias", L, lambda b: b),
+            "bk": _stack(sd, lt + "self_attn.k_proj.bias", L, lambda b: b),
+            "bv": _stack(sd, lt + "self_attn.v_proj.bias", L, lambda b: b),
+        })
     params = {
         "embed": jnp.asarray(_np(sd["model.embed_tokens.weight"])),
         "layers": layers,
@@ -141,6 +145,28 @@ def config_from_hf(hf_config) -> ModelConfig:
             norm_eps=hf_config.layer_norm_eps,
             rope_theta=getattr(hf_config, "rotary_emb_base", 10000.0),
             rotary_pct=hf_config.rotary_pct,
+            tie_word_embeddings=hf_config.tie_word_embeddings,
+        )
+    if mt == "llama":
+        if getattr(hf_config, "rope_scaling", None):
+            raise ValueError("llama rope_scaling is not supported (vanilla RoPE only)")
+        if getattr(hf_config, "attention_bias", False):
+            raise ValueError("llama with attention_bias=True is not supported")
+        hd = getattr(hf_config, "head_dim", None)
+        if hd and hd * hf_config.num_attention_heads != hf_config.hidden_size:
+            raise ValueError("llama with head_dim != hidden_size/num_heads is "
+                             "not supported")
+        return ModelConfig(
+            family="llama",
+            vocab_size=hf_config.vocab_size,
+            hidden_size=hf_config.hidden_size,
+            num_layers=hf_config.num_hidden_layers,
+            num_heads=hf_config.num_attention_heads,
+            num_kv_heads=hf_config.num_key_value_heads,
+            intermediate_size=hf_config.intermediate_size,
+            max_position_embeddings=hf_config.max_position_embeddings,
+            norm_eps=hf_config.rms_norm_eps,
+            rope_theta=hf_config.rope_theta,
             tie_word_embeddings=hf_config.tie_word_embeddings,
         )
     if mt == "qwen2":

@@ -357,9 +357,12 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> dict:
         "ln2_scale": jnp.ones((L, D), dtype),
         "wq": init(L, D, H * hd), "wk": init(L, D, KV * hd), "wv": init(L, D, KV * hd),
         "wo": init(L, H * hd, D),
-        "bq": jnp.zeros((L, H * hd), dtype), "bk": jnp.zeros((L, KV * hd), dtype),
-        "bv": jnp.zeros((L, KV * hd), dtype),
     }
+    if cfg.qkv_bias:
+        layers.update({
+            "bq": jnp.zeros((L, H * hd), dtype), "bk": jnp.zeros((L, KV * hd), dtype),
+            "bv": jnp.zeros((L, KV * hd), dtype),
+        })
     if cfg.family == "gpt_neox":
         layers.update({
             "ln1_bias": jnp.zeros((L, D), dtype), "ln2_bias": jnp.zeros((L, D), dtype),

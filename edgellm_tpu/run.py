@@ -109,8 +109,10 @@ def main(argv=None) -> int:
     ap.add_argument("--output-dir", default=".")
     ap.add_argument("--max-chunks", type=int, help="stop after N chunks (smoke/CI)")
     ap.add_argument("--window-batch", type=int, default=8,
-                    help="evaluation windows batched per forward in the token "
-                         "sweep (identical accumulation; feeds the MXU)")
+                    help="evaluation windows batched per forward in the token, "
+                         "initial, channel, and split experiments (identical "
+                         "accumulation; feeds the MXU; for split with a data "
+                         "mesh axis, must be a multiple of its size)")
     ap.add_argument("--checkpoint-every", type=int, default=1000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--synthetic-corpus-len", type=int, default=4096)
@@ -142,6 +144,7 @@ def main(argv=None) -> int:
         checkpoint_every=args.checkpoint_every,
         metrics_path=out("metrics.jsonl"),
         max_chunks=args.max_chunks,
+        window_batch=max(args.window_batch, 1),
     )
 
     if experiment == "relevance":
@@ -213,7 +216,8 @@ def main(argv=None) -> int:
             max_length=max_length, stride=stride,
             importance_method=params_json.get("importance_method"),
             head_weights=load_head_weights(),
-            max_chunks=args.max_chunks)
+            max_chunks=args.max_chunks,
+            window_batch=max(args.window_batch, 1))
         with open(out("split_eval_results.json"), "w") as f:
             json.dump(result, f, indent=1)
         print(json.dumps(result))
@@ -235,8 +239,7 @@ def main(argv=None) -> int:
         result = run_token_sweep(
             cfg, params, corpus, methods=methods or ["regular_importance"],
             layers_of_interest=params_json["layers_of_interest"],
-            ratios=params_json["ratios"], head_weights=head_weights,
-            window_batch=max(args.window_batch, 1), **common)
+            ratios=params_json["ratios"], head_weights=head_weights, **common)
 
     with open(out("avg_ppl_results.json"), "w") as f:
         json.dump(result.to_json(), f, indent=1)

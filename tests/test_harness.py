@@ -172,3 +172,26 @@ def test_metrics_jsonl_written(setup, tmp_path):
     lines = [json.loads(l) for l in open(mpath)]
     assert any(rec.get("final") for rec in lines)
     assert all("ppl" in rec for rec in lines)
+
+
+def test_channel_window_batching_is_exact(setup):
+    """Batched channel sweep: per-window channel scales preserved -> totals
+    identical to the chunk-by-chunk run."""
+    params, corpus = setup
+    kw = dict(methods=["channel_8", "channel_1_mean"], layers_of_interest=[2],
+              max_length=48, stride=24)
+    single = run_channel_sweep(CFG, params, corpus, **kw)
+    batched = run_channel_sweep(CFG, params, corpus, window_batch=3, **kw)
+    assert batched.chunks == single.chunks
+    np.testing.assert_allclose(batched.total_nll, single.total_nll, rtol=1e-5, atol=1e-5)
+
+
+def test_initial_window_batching_is_exact(setup):
+    """Batched initial sweep: per-window orderings/top-rho masses preserved."""
+    params, corpus = setup
+    kw = dict(layers_of_interest=[1, "aggregate upto 2", "upto ratio"],
+              ratios=[0, 5, 10], max_length=48, stride=24, quant_layer=1)
+    single = run_initial_sweep(CFG, params, corpus, **kw)
+    batched = run_initial_sweep(CFG, params, corpus, window_batch=3, **kw)
+    assert batched.chunks == single.chunks
+    np.testing.assert_allclose(batched.total_nll, single.total_nll, rtol=1e-5, atol=1e-5)

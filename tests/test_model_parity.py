@@ -14,7 +14,8 @@ import torch
 
 torch.manual_seed(0)
 
-from transformers import GPTNeoXConfig, GPTNeoXForCausalLM, Qwen2Config, Qwen2ForCausalLM
+from transformers import (GPTNeoXConfig, GPTNeoXForCausalLM, Qwen2Config,
+                          Qwen2ForCausalLM, LlamaConfig, LlamaForCausalLM)
 
 import jax.numpy as jnp
 
@@ -45,9 +46,21 @@ def _build_qwen2():
     return hf_cfg, model
 
 
-@pytest.fixture(scope="module", params=["gpt_neox", "qwen2"])
+def _build_llama():
+    hf_cfg = LlamaConfig(
+        vocab_size=256, hidden_size=64, num_hidden_layers=3, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=128, max_position_embeddings=128,
+        rms_norm_eps=1e-5, rope_theta=500000.0, tie_word_embeddings=True,
+        attention_bias=False, attn_implementation="eager",
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    return hf_cfg, model
+
+
+@pytest.fixture(scope="module", params=["gpt_neox", "qwen2", "llama"])
 def family_setup(request):
-    builder = _build_neox if request.param == "gpt_neox" else _build_qwen2
+    builder = {"gpt_neox": _build_neox, "qwen2": _build_qwen2,
+               "llama": _build_llama}[request.param]
     hf_cfg, model = builder()
     cfg = config_from_hf(hf_cfg)
     params = params_from_state_dict(cfg, model.state_dict())

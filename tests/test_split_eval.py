@@ -80,3 +80,33 @@ def test_multihop_split_eval(setup):
     assert np.isfinite(res["ppl"])
     assert res["mesh"]["stage"] == 3
     assert len(res["bytes_per_token_per_hop"]) == 2
+
+
+@pytest.mark.parametrize("n_data", [1, 2])
+def test_window_batched_split_eval_matches_unbatched(setup, n_data):
+    """window_batch > 1 (optionally data-sharded) must reproduce the
+    chunk-by-chunk split eval exactly, including with a token-selective hop
+    carrying per-row importance."""
+    from edgellm_tpu.parallel import make_stage_mesh
+
+    params, corpus = setup
+    kw = dict(cuts=[2], hop_codecs=["selective_int4:0.5:fp32"],
+              max_length=16, stride=8, importance_method="regular_importance",
+              time_hops=False)
+    want = run_split_eval(CFG, params, corpus,
+                          mesh=make_stage_mesh(2), **kw)
+    got = run_split_eval(CFG, params, corpus, window_batch=4,
+                         mesh=make_stage_mesh(2, n_data=n_data), **kw)
+    assert got["chunks"] == want["chunks"]
+    assert got["n_tokens"] == want["n_tokens"]
+    np.testing.assert_allclose(got["ppl"], want["ppl"], rtol=1e-6)
+
+
+def test_window_batch_not_multiple_of_data_axis_raises(setup):
+    from edgellm_tpu.parallel import make_stage_mesh
+
+    params, corpus = setup
+    with pytest.raises(ValueError, match="multiple"):
+        run_split_eval(CFG, params, corpus, cuts=[2], hop_codecs=["fp32"],
+                       max_length=16, stride=8, window_batch=3,
+                       mesh=make_stage_mesh(2, n_data=2), time_hops=False)
