@@ -14,7 +14,7 @@ vs_baseline > 1 means faster than the reference's s/chunk on its hardware,
 plus observability fields: tokens_per_s (scored tokens), model_tflops_per_s and
 mfu (analytic sweep FLOPs vs the chip's assumed bf16 peak).
 
-Env knobs: BENCH_CHUNKS (default 96), BENCH_WINDOW_BATCH (default 32 — batches
+Env knobs: BENCH_CHUNKS (default 96), BENCH_WINDOW_BATCH (default 64 — batches
 evaluation windows into one executable to feed the MXU), BENCH_DTYPE
 (float32|bfloat16, default bfloat16), BENCH_PEAK_TFLOPS (assumed bf16 peak for
 the MFU denominator, default 197 = TPU v5e).
@@ -36,7 +36,7 @@ def main():
     from edgellm_tpu.utils.flops import token_sweep_flops_per_chunk
 
     n_chunks = int(os.environ.get("BENCH_CHUNKS", "96"))
-    window_batch = int(os.environ.get("BENCH_WINDOW_BATCH", "32"))
+    window_batch = int(os.environ.get("BENCH_WINDOW_BATCH", "64"))
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
         os.environ.get("BENCH_DTYPE", "bfloat16")]

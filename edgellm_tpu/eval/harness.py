@@ -252,6 +252,23 @@ class SweepResult:
         return "\n".join(lines)
 
 
+def _scoring_tail(chunk) -> int:
+    """Scoring-tail length of one window: trg_len = num_loss_tokens + 1 (the
+    windowing shift correction), clamped to the unembeddable positions."""
+    return min(chunk.num_loss_tokens + 1, chunk.input_ids.shape[1] - 1)
+
+
+def _group_arrays(group):
+    """One window group -> (ids (W, S), targets (W, S), counts (W,), tail).
+    The group's max tail bounds every member's scoring span, so a single
+    static tail keeps one executable per group shape while staying exact."""
+    ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
+    targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
+    counts = np.array([c.num_loss_tokens for c in group], np.float64)
+    tail = max(c.num_loss_tokens + 1 for c in group)
+    return ids, targets, counts, tail
+
+
 def _iter_window_groups(token_ids, max_length: int, stride: int, *,
                         window_batch: int, start_chunk: int = 0,
                         max_count: Optional[int] = None, tail_of=None):
@@ -387,13 +404,7 @@ def run_token_sweep(
 
     def process_group(group):
         nonlocal next_chunk, last_ckpt
-        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))  # (W, S)
-        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
-        counts = np.array([c.num_loss_tokens for c in group], np.float64)
-        # trg_len = num_loss_tokens + 1 (the windowing shift correction); the
-        # group's max bounds every member's scoring span, so a single static
-        # tail keeps one executable per group shape while staying exact
-        tail = max(c.num_loss_tokens + 1 for c in group)
+        ids, targets, counts, tail = _group_arrays(group)
         # k per ratio, truncated in Python float64 exactly like the reference's
         # int(ratio * s) (qwen_layer_wise.py:57) and the wire codecs
         ks = jnp.asarray([int(float(ratios[i]) * ids.shape[1]) for i in nz_idx],
@@ -428,12 +439,11 @@ def run_token_sweep(
             _emit(metrics_path, {"chunk": group[-1].index, "n_tokens": result.n_tokens,
                                  "ppl": result.ppl().tolist()})
 
-    tail_of = lambda c: min(c.num_loss_tokens + 1, c.input_ids.shape[1] - 1)
     remaining = None if max_chunks is None else max_chunks - result.chunks
     for group in _iter_window_groups(token_ids, max_length, stride,
                                      window_batch=window_batch,
                                      start_chunk=start_chunk,
-                                     max_count=remaining, tail_of=tail_of):
+                                     max_count=remaining, tail_of=_scoring_tail):
         process_group(group)
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
@@ -492,16 +502,13 @@ def run_initial_sweep(
     t0 = time.monotonic()
     next_chunk = start_chunk
     last_ckpt = result.chunks
-    tail_of = lambda c: min(c.num_loss_tokens + 1, c.input_ids.shape[1] - 1)
     remaining = None if max_chunks is None else max_chunks - result.chunks
 
     for group in _iter_window_groups(token_ids, max_length, stride,
                                      window_batch=window_batch,
                                      start_chunk=start_chunk,
-                                     max_count=remaining, tail_of=tail_of):
-        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
-        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
-        tail = max(c.num_loss_tokens + 1 for c in group)
+                                     max_count=remaining, tail_of=_scoring_tail):
+        ids, targets, counts, tail = _group_arrays(group)
         ks = jnp.asarray([int(0.1 * r * ids.shape[1]) for r in ratios], jnp.int32)
         stats, hiddens = stats_fn(params, ids)
         next_chunk = group[-1].index + 1
@@ -519,7 +526,7 @@ def run_initial_sweep(
                 params, hiddens[quant_layer], targets, imp, fracs, ks)  # (R, W)
             # unweighted mean-of-chunk-means: each window contributes equally
             result.total_nll[l] += np.asarray(nlls, np.float64).sum(axis=1)
-        result.n_tokens += sum(c.num_loss_tokens for c in group)
+        result.n_tokens += counts.sum()
         result.chunks += len(group)
         if result.chunks - last_ckpt >= checkpoint_every:
             last_ckpt = result.chunks
@@ -568,16 +575,12 @@ def run_channel_sweep(
     t0 = time.monotonic()
     next_chunk = start_chunk
     last_ckpt = result.chunks
-    tail_of = lambda c: min(c.num_loss_tokens + 1, c.input_ids.shape[1] - 1)
     remaining = None if max_chunks is None else max_chunks - result.chunks
     for group in _iter_window_groups(token_ids, max_length, stride,
                                      window_batch=window_batch,
                                      start_chunk=start_chunk,
-                                     max_count=remaining, tail_of=tail_of):
-        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
-        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
-        counts = np.array([c.num_loss_tokens for c in group], np.float64)
-        tail = max(c.num_loss_tokens + 1 for c in group)
+                                     max_count=remaining, tail_of=_scoring_tail):
+        ids, targets, counts, tail = _group_arrays(group)
         hiddens = fwd(params, ids)  # (L, W, S, D)
         next_chunk = group[-1].index + 1
         for m, method in enumerate(methods):

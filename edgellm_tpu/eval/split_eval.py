@@ -26,6 +26,7 @@ from ..models.transformer import nll_from_logits, run_layers_from_ids
 from ..importance import importance_per_layer
 from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
 from ..codecs.packing import WireCodec, selective_int4
+from .harness import _iter_window_groups
 
 
 def parse_hop_codec(spec: str) -> object:
@@ -146,8 +147,6 @@ def run_split_eval(
         chunks += n_real
         if progress:
             progress(group[-1].index)
-
-    from .harness import _iter_window_groups
 
     for group in _iter_window_groups(token_ids, max_length, stride,
                                      window_batch=window_batch,

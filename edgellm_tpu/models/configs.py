@@ -11,6 +11,7 @@ network access to pull pretrained weights).
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,6 +39,10 @@ class ModelConfig:
     rope_theta: float = 10000.0
     rotary_pct: float = 1.0
     tie_word_embeddings: bool = False
+    #: llama3 RoPE frequency rescaling, or None for vanilla RoPE. Tuple form
+    #: ("llama3", factor, low_freq_factor, high_freq_factor,
+    #: original_max_position_embeddings) — hashable for the frozen config.
+    rope_scaling: Optional[tuple] = None
 
     @property
     def head_dim(self) -> int:
@@ -107,7 +112,8 @@ QWEN2_1_5B = ModelConfig(
     tie_word_embeddings=True,
 )
 
-# meta-llama/Llama-3.2-1B — beyond-parity family (edge-sized Llama).
+# meta-llama/Llama-3.2-1B — beyond-parity family (edge-sized Llama). Ships
+# llama3 RoPE rescaling (factor 32 over an 8192-token original window).
 LLAMA_3_2_1B = ModelConfig(
     family="llama",
     vocab_size=128256,
@@ -120,6 +126,7 @@ LLAMA_3_2_1B = ModelConfig(
     norm_eps=1e-5,
     rope_theta=500000.0,
     tie_word_embeddings=True,
+    rope_scaling=("llama3", 32.0, 1.0, 4.0, 8192),
 )
 
 

@@ -148,8 +148,17 @@ def config_from_hf(hf_config) -> ModelConfig:
             tie_word_embeddings=hf_config.tie_word_embeddings,
         )
     if mt == "llama":
-        if getattr(hf_config, "rope_scaling", None):
-            raise ValueError("llama rope_scaling is not supported (vanilla RoPE only)")
+        scaling = getattr(hf_config, "rope_scaling", None)
+        rope_scaling = None
+        if scaling:
+            kind = scaling.get("rope_type", scaling.get("type"))
+            if kind != "llama3":
+                raise ValueError(f"llama rope_scaling type {kind!r} is not "
+                                 f"supported (llama3 or none)")
+            rope_scaling = ("llama3", float(scaling["factor"]),
+                            float(scaling["low_freq_factor"]),
+                            float(scaling["high_freq_factor"]),
+                            int(scaling["original_max_position_embeddings"]))
         if getattr(hf_config, "attention_bias", False):
             raise ValueError("llama with attention_bias=True is not supported")
         hd = getattr(hf_config, "head_dim", None)
@@ -168,6 +177,7 @@ def config_from_hf(hf_config) -> ModelConfig:
             norm_eps=hf_config.rms_norm_eps,
             rope_theta=hf_config.rope_theta,
             tie_word_embeddings=hf_config.tie_word_embeddings,
+            rope_scaling=rope_scaling,
         )
     if mt == "qwen2":
         if getattr(hf_config, "rope_scaling", None):

@@ -51,10 +51,31 @@ def precompute_rope(cfg: ModelConfig, seq_len: int) -> tuple[jnp.ndarray, jnp.nd
     """cos/sin tables, fp32, HF convention: emb = concat(freqs, freqs)."""
     rot = cfg.rotary_dim
     inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, rot, 2, dtype=jnp.float32) / rot))
+    if cfg.rope_scaling is not None:
+        inv_freq = _llama3_scale_freqs(inv_freq, cfg.rope_scaling)
     pos = jnp.arange(seq_len, dtype=jnp.float32)
     freqs = jnp.outer(pos, inv_freq)  # (S, rot/2)
     emb = jnp.concatenate([freqs, freqs], axis=-1)  # (S, rot)
     return jnp.cos(emb), jnp.sin(emb)
+
+
+def _llama3_scale_freqs(inv_freq: jnp.ndarray, scaling: tuple) -> jnp.ndarray:
+    """Llama-3.x RoPE frequency rescaling (transformers'
+    ``_compute_llama3_parameters``): long-wavelength components are slowed by
+    ``factor``, short ones kept, with a smooth ramp between the two cutoff
+    wavelengths. ``scaling`` = ("llama3", factor, low_freq_factor,
+    high_freq_factor, original_max_position_embeddings)."""
+    kind, factor, low_ff, high_ff, orig = scaling
+    if kind != "llama3":
+        raise ValueError(f"unsupported rope_scaling type {kind!r}")
+    low_wavelen = orig / low_ff
+    high_wavelen = orig / high_ff
+    wavelen = 2.0 * jnp.pi / inv_freq
+    smooth = (orig / wavelen - low_ff) / (high_ff - low_ff)
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    scaled = jnp.where(wavelen > low_wavelen, inv_freq / factor,
+                       jnp.where(wavelen < high_wavelen, inv_freq, smoothed))
+    return scaled
 
 
 def _rotate_half(x):

@@ -52,6 +52,9 @@ def _build_llama():
         num_key_value_heads=2, intermediate_size=128, max_position_embeddings=128,
         rms_norm_eps=1e-5, rope_theta=500000.0, tie_word_embeddings=True,
         attention_bias=False, attn_implementation="eager",
+        rope_scaling={"rope_type": "llama3", "factor": 32.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64},
     )
     model = LlamaForCausalLM(hf_cfg).eval()
     return hf_cfg, model

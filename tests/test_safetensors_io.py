@@ -137,7 +137,7 @@ def test_load_checkpoint_dir_with_shards_and_config(tmp_path, rng):
 
 
 def test_config_from_dir_rejects_unknown_family(tmp_path):
-    (tmp_path / "config.json").write_text(json.dumps({"model_type": "llama"}))
+    (tmp_path / "config.json").write_text(json.dumps({"model_type": "mistral"}))
     with pytest.raises(ValueError, match="unsupported model_type"):
         config_from_dir(str(tmp_path))
 
