@@ -11,6 +11,10 @@ from .lib import (
     ternary_pack,
     ternary_unpack,
     int4_payload_bytes,
+    int8_per_channel_encode,
+    int8_per_channel_decode,
+    int4_per_channel_encode,
+    int4_per_channel_decode,
 )
 
 __all__ = [
@@ -20,4 +24,8 @@ __all__ = [
     "ternary_pack",
     "ternary_unpack",
     "int4_payload_bytes",
+    "int8_per_channel_encode",
+    "int8_per_channel_decode",
+    "int4_per_channel_encode",
+    "int4_per_channel_decode",
 ]

@@ -59,6 +59,10 @@ def _load():
         lib.ternary_unpack.argtypes = [u8p, i64, i64, i8p]
         lib.int4_per_token_payload_bytes.argtypes = [i64, i64]
         lib.int4_per_token_payload_bytes.restype = i64
+        lib.int8_per_channel_encode.argtypes = [f32p, i64, i64, i8p, f32p]
+        lib.int8_per_channel_decode.argtypes = [i8p, f32p, i64, i64, f32p]
+        lib.int4_per_channel_encode.argtypes = [f32p, i64, i64, u8p, f32p]
+        lib.int4_per_channel_decode.argtypes = [u8p, f32p, i64, i64, f32p]
         _lib = lib
         return _lib
 
@@ -72,11 +76,16 @@ def _ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
 
 
-def int4_per_token_encode(x: np.ndarray):
-    """fp32 (N, D) -> (packed (N, D/2) uint8, scales (N,) fp32), on the host."""
+def _require():
     lib = _load()
     if lib is None:
         raise RuntimeError("native packing library unavailable (no g++?)")
+    return lib
+
+
+def int4_per_token_encode(x: np.ndarray):
+    """fp32 (N, D) -> (packed (N, D/2) uint8, scales (N,) fp32), on the host."""
+    lib = _require()
     x = np.ascontiguousarray(x, np.float32)
     n, d = x.shape
     if d % 2:
@@ -89,9 +98,7 @@ def int4_per_token_encode(x: np.ndarray):
 
 
 def int4_per_token_decode(packed: np.ndarray, scales: np.ndarray) -> np.ndarray:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError("native packing library unavailable (no g++?)")
+    lib = _require()
     packed = np.ascontiguousarray(packed, np.uint8)
     scales = np.ascontiguousarray(scales, np.float32)
     n, half = packed.shape
@@ -102,9 +109,7 @@ def int4_per_token_decode(packed: np.ndarray, scales: np.ndarray) -> np.ndarray:
 
 
 def ternary_pack(codes: np.ndarray) -> np.ndarray:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError("native packing library unavailable (no g++?)")
+    lib = _require()
     codes = np.ascontiguousarray(codes, np.int8)
     n, d = codes.shape
     if d % 4:
@@ -115,9 +120,7 @@ def ternary_pack(codes: np.ndarray) -> np.ndarray:
 
 
 def ternary_unpack(packed: np.ndarray) -> np.ndarray:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError("native packing library unavailable (no g++?)")
+    lib = _require()
     packed = np.ascontiguousarray(packed, np.uint8)
     n, q = packed.shape
     codes = np.empty((n, q * 4), np.int8)
@@ -126,7 +129,61 @@ def ternary_unpack(packed: np.ndarray) -> np.ndarray:
 
 
 def int4_payload_bytes(n_tokens: int, dim: int) -> int:
-    lib = _load()
-    if lib is None:
-        raise RuntimeError("native packing library unavailable (no g++?)")
+    lib = _require()
     return int(lib.int4_per_token_payload_bytes(n_tokens, dim))
+
+
+def int8_per_channel_encode(x: np.ndarray):
+    """fp32 (N, D) -> (codes (N, D) int8, channel scales (D,) fp32)."""
+    lib = _require()
+    x = np.ascontiguousarray(x, np.float32)
+    n, d = x.shape
+    q = np.empty((n, d), np.int8)
+    scales = np.empty(d, np.float32)
+    lib.int8_per_channel_encode(_ptr(x, ctypes.c_float), n, d,
+                                _ptr(q, ctypes.c_int8), _ptr(scales, ctypes.c_float))
+    return q, scales
+
+
+def int8_per_channel_decode(q: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    lib = _require()
+    q = np.ascontiguousarray(q, np.int8)
+    scales = np.ascontiguousarray(scales, np.float32)
+    n, d = q.shape
+    if scales.size != d:
+        raise ValueError(f"per-channel scales must have length {d} (the feature "
+                         f"dim), got {scales.size}")
+    out = np.empty((n, d), np.float32)
+    lib.int8_per_channel_decode(_ptr(q, ctypes.c_int8), _ptr(scales, ctypes.c_float),
+                                n, d, _ptr(out, ctypes.c_float))
+    return out
+
+
+def int4_per_channel_encode(x: np.ndarray):
+    """fp32 (N, D) -> (packed (N, D/2) uint8, channel scales (D,) fp32)."""
+    lib = _require()
+    x = np.ascontiguousarray(x, np.float32)
+    n, d = x.shape
+    if d % 2:
+        raise ValueError(f"int4 packing needs an even feature dim, got {d}")
+    packed = np.empty((n, d // 2), np.uint8)
+    scales = np.empty(d, np.float32)
+    lib.int4_per_channel_encode(_ptr(x, ctypes.c_float), n, d,
+                                _ptr(packed, ctypes.c_uint8),
+                                _ptr(scales, ctypes.c_float))
+    return packed, scales
+
+
+def int4_per_channel_decode(packed: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    lib = _require()
+    packed = np.ascontiguousarray(packed, np.uint8)
+    scales = np.ascontiguousarray(scales, np.float32)
+    n, half = packed.shape
+    if scales.size != half * 2:
+        raise ValueError(f"per-channel scales must have length {half * 2} (the "
+                         f"feature dim), got {scales.size}")
+    out = np.empty((n, half * 2), np.float32)
+    lib.int4_per_channel_decode(_ptr(packed, ctypes.c_uint8),
+                                _ptr(scales, ctypes.c_float),
+                                n, half * 2, _ptr(out, ctypes.c_float))
+    return out

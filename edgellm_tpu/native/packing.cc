@@ -89,4 +89,74 @@ int64_t int4_per_token_payload_bytes(int64_t n_tokens, int64_t dim) {
   return n_tokens * (dim / 2) + n_tokens * static_cast<int64_t>(sizeof(float));
 }
 
+// Shared: per-channel max-abs scales over all tokens (zero channels -> 1.0,
+// matching packing._int8_per_channel / _int4_per_channel).
+static void channel_absmax_scales(const float* x, int64_t n_tokens, int64_t dim,
+                                  float* scales) {
+  for (int64_t c = 0; c < dim; ++c) scales[c] = 0.0f;
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    const float* row = x + t * dim;
+    for (int64_t c = 0; c < dim; ++c)
+      scales[c] = std::max(scales[c], std::fabs(row[c]));
+  }
+  for (int64_t c = 0; c < dim; ++c)
+    if (!(scales[c] > 0.0f)) scales[c] = 1.0f;
+}
+
+// fp32 (n_tokens, dim) -> per-channel symmetric int8 codes + dim fp32 scales
+// (the reference's channel_8 loop, qwen_layer_wise.py:125-134, vectorized).
+void int8_per_channel_encode(const float* x, int64_t n_tokens, int64_t dim,
+                             int8_t* q, float* scales) {
+  channel_absmax_scales(x, n_tokens, dim, scales);
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    const float* row = x + t * dim;
+    int8_t* out = q + t * dim;
+    for (int64_t c = 0; c < dim; ++c)
+      out[c] = static_cast<int8_t>(std::nearbyintf(row[c] / scales[c] * 127.0f));
+  }
+}
+
+void int8_per_channel_decode(const int8_t* q, const float* scales,
+                             int64_t n_tokens, int64_t dim, float* out) {
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    const int8_t* row = q + t * dim;
+    float* o = out + t * dim;
+    for (int64_t c = 0; c < dim; ++c)
+      o[c] = static_cast<float>(row[c]) * scales[c] / 127.0f;
+  }
+}
+
+// fp32 (n_tokens, dim) -> per-channel symmetric int4 nibbles (contiguous-half
+// layout) + dim fp32 scales (channel_4, qwen_layer_wise.py:128-134).
+void int4_per_channel_encode(const float* x, int64_t n_tokens, int64_t dim,
+                             uint8_t* packed, float* scales) {
+  channel_absmax_scales(x, n_tokens, dim, scales);
+  const int64_t half = dim / 2;
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    const float* row = x + t * dim;
+    uint8_t* out = packed + t * half;
+    for (int64_t i = 0; i < half; ++i) {
+      const int lo = static_cast<int>(
+          std::nearbyintf(row[i] / scales[i] * 7.0f)) + 8;
+      const int hi = static_cast<int>(
+          std::nearbyintf(row[i + half] / scales[i + half] * 7.0f)) + 8;
+      out[i] = static_cast<uint8_t>((lo & 0xF) | ((hi & 0xF) << 4));
+    }
+  }
+}
+
+void int4_per_channel_decode(const uint8_t* packed, const float* scales,
+                             int64_t n_tokens, int64_t dim, float* out) {
+  const int64_t half = dim / 2;
+  for (int64_t t = 0; t < n_tokens; ++t) {
+    const uint8_t* row = packed + t * half;
+    float* o = out + t * dim;
+    for (int64_t i = 0; i < half; ++i) {
+      o[i] = static_cast<float>((row[i] & 0xF) - 8) * scales[i] / 7.0f;
+      o[i + half] =
+          static_cast<float>(((row[i] >> 4) & 0xF) - 8) * scales[i + half] / 7.0f;
+    }
+  }
+}
+
 }  // extern "C"

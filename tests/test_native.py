@@ -50,3 +50,25 @@ def test_constant_and_zero_rows():
     out = native.int4_per_token_decode(packed, scales)
     np.testing.assert_allclose(out[0], 0.0)
     np.testing.assert_allclose(out[1], 3.25, rtol=1e-6)
+
+
+def test_int8_per_channel_bitwise_matches_jax(rng):
+    x = rng.normal(size=(24, 32)).astype(np.float32)
+    q_c, scales_c = native.int8_per_channel_encode(x)
+    want = get_wire_codec("int8_per_channel").encode(jnp.asarray(x[None]))
+    np.testing.assert_array_equal(q_c, np.asarray(want["q"][0]))
+    np.testing.assert_allclose(scales_c, np.asarray(want["scale"]).reshape(-1),
+                               rtol=1e-7)
+    out = native.int8_per_channel_decode(q_c, scales_c)
+    codec = get_wire_codec("int8_per_channel")
+    np.testing.assert_allclose(out, np.asarray(codec.decode(want))[0], atol=1e-6)
+
+
+def test_int4_per_channel_bitwise_matches_jax(rng):
+    x = rng.normal(size=(24, 32)).astype(np.float32)
+    packed_c, scales_c = native.int4_per_channel_encode(x)
+    want = get_wire_codec("int4_per_channel").encode(jnp.asarray(x[None]))
+    np.testing.assert_array_equal(packed_c, np.asarray(want["packed"][0]))
+    out = native.int4_per_channel_decode(packed_c, scales_c)
+    codec = get_wire_codec("int4_per_channel")
+    np.testing.assert_allclose(out, np.asarray(codec.decode(want))[0], atol=1e-6)
