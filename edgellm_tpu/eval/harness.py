@@ -402,8 +402,9 @@ def run_token_sweep(
     next_chunk = start_chunk
     last_ckpt = result.chunks
 
-    def process_group(group):
-        nonlocal next_chunk, last_ckpt
+    def submit_group(group):
+        """Enqueue all of one group's device work; NO host sync — returns the
+        device result handles for a later drain."""
         ids, targets, counts, tail = _group_arrays(group)
         # k per ratio, truncated in Python float64 exactly like the reference's
         # int(ratio * s) (qwen_layer_wise.py:57) and the wire codecs
@@ -411,8 +412,6 @@ def run_token_sweep(
                          jnp.int32)
         stats, hiddens = stats_fn(params, ids)  # hiddens (L, W, S, D)
         imp_all = imp_fn(stats, hw)  # (M, L, W, S), one device call
-        # enqueue every suffix executable before any host sync so dispatch
-        # round-trips overlap with device compute
         pending = []  # (m_indices, l, ratio_indices, device_nlls)
         for l, layer in enumerate(layers_of_interest):
             h_l = hiddens[layer]
@@ -424,6 +423,13 @@ def run_token_sweep(
                     nlls = _suffix_sweep(cfg, int(layer), codec, tail)(
                         params, h_l, targets, imp_all[m, layer], nz_ratios, ks)  # (R', W)
                     pending.append(([m], l, nz_idx, nlls))
+        return group, counts, pending
+
+    def drain_group(rec):
+        """Accumulate one submitted group (host syncs happen here, one group
+        behind submission so conversions overlap the next group's compute)."""
+        nonlocal next_chunk, last_ckpt
+        group, counts, pending = rec
         for ms, l, r_idx, nlls in pending:
             contrib = np.asarray(nlls, np.float64) @ counts  # (R',)
             for m in ms:
@@ -440,11 +446,17 @@ def run_token_sweep(
                                  "ppl": result.ppl().tolist()})
 
     remaining = None if max_chunks is None else max_chunks - result.chunks
+    inflight = None
     for group in _iter_window_groups(token_ids, max_length, stride,
                                      window_batch=window_batch,
                                      start_chunk=start_chunk,
                                      max_count=remaining, tail_of=_scoring_tail):
-        process_group(group)
+        rec = submit_group(group)
+        if inflight is not None:
+            drain_group(inflight)
+        inflight = rec
+    if inflight is not None:
+        drain_group(inflight)
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,

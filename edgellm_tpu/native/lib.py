@@ -40,6 +40,22 @@ def _compile() -> bool:
     return True
 
 
+def _bind(lib):
+    i64, f32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
+    u8p, i8p = ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int8)
+    lib.int4_per_token_encode.argtypes = [f32p, i64, i64, u8p, f32p]
+    lib.int4_per_token_decode.argtypes = [u8p, f32p, i64, i64, f32p]
+    lib.ternary_pack.argtypes = [i8p, i64, i64, u8p]
+    lib.ternary_unpack.argtypes = [u8p, i64, i64, i8p]
+    lib.int4_per_token_payload_bytes.argtypes = [i64, i64]
+    lib.int4_per_token_payload_bytes.restype = i64
+    lib.int8_per_channel_encode.argtypes = [f32p, i64, i64, i8p, f32p]
+    lib.int8_per_channel_decode.argtypes = [i8p, f32p, i64, i64, f32p]
+    lib.int4_per_channel_encode.argtypes = [f32p, i64, i64, u8p, f32p]
+    lib.int4_per_channel_decode.argtypes = [u8p, f32p, i64, i64, f32p]
+    return lib
+
+
 def _load():
     global _lib, _failed
     with _lock:
@@ -50,20 +66,19 @@ def _load():
         if stale and not _compile():
             _failed = True
             return None
-        lib = ctypes.CDLL(_SO)
-        i64, f32p = ctypes.c_int64, ctypes.POINTER(ctypes.c_float)
-        u8p, i8p = ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int8)
-        lib.int4_per_token_encode.argtypes = [f32p, i64, i64, u8p, f32p]
-        lib.int4_per_token_decode.argtypes = [u8p, f32p, i64, i64, f32p]
-        lib.ternary_pack.argtypes = [i8p, i64, i64, u8p]
-        lib.ternary_unpack.argtypes = [u8p, i64, i64, i8p]
-        lib.int4_per_token_payload_bytes.argtypes = [i64, i64]
-        lib.int4_per_token_payload_bytes.restype = i64
-        lib.int8_per_channel_encode.argtypes = [f32p, i64, i64, i8p, f32p]
-        lib.int8_per_channel_decode.argtypes = [i8p, f32p, i64, i64, f32p]
-        lib.int4_per_channel_encode.argtypes = [f32p, i64, i64, u8p, f32p]
-        lib.int4_per_channel_decode.argtypes = [u8p, f32p, i64, i64, f32p]
-        _lib = lib
+        try:
+            _lib = _bind(ctypes.CDLL(_SO))
+        except (OSError, AttributeError):
+            # a cached .so that predates the current symbol set (mtime-
+            # preserving copies defeat the staleness check) — rebuild once
+            if not _compile():
+                _failed = True
+                return None
+            try:
+                _lib = _bind(ctypes.CDLL(_SO))
+            except (OSError, AttributeError):
+                _failed = True
+                return None
         return _lib
 
 
