@@ -113,6 +113,10 @@ def main(argv=None) -> int:
                          "initial, channel, and split experiments (identical "
                          "accumulation; feeds the MXU; for split with a data "
                          "mesh axis, must be a multiple of its size)")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="capture an XLA profiler trace of the experiment into "
+                         "DIR (view with TensorBoard/Perfetto; includes "
+                         "ppermute hops and Pallas codec kernels)")
     ap.add_argument("--checkpoint-every", type=int, default=1000)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--synthetic-corpus-len", type=int, default=4096)
@@ -134,120 +138,132 @@ def main(argv=None) -> int:
     os.makedirs(args.output_dir, exist_ok=True)
     out = lambda name: os.path.join(args.output_dir, name)
 
-    experiment = params_json.get("experiment", "")
-    methods = params_json.get("methods", [])
-    max_length = params_json.get("max_length", cfg.max_position_embeddings)
-    stride = params_json.get("stride", 32)
-    common = dict(
-        max_length=max_length, stride=stride,
-        checkpoint_path=out("sweep_checkpoint.json"),
-        checkpoint_every=args.checkpoint_every,
-        metrics_path=out("metrics.jsonl"),
-        max_chunks=args.max_chunks,
-        window_batch=max(args.window_batch, 1),
-    )
+    import contextlib
 
-    if experiment == "relevance":
-        try:
-            from .importance.relevance import run_relevance_extraction
-        except ImportError as e:
-            raise SystemExit(f"relevance extraction unavailable: {e}") from e
-
-        weights = run_relevance_extraction(
-            cfg, params, corpus, max_length=max_length, stride=stride,
-            max_chunks=args.max_chunks)
-        with open(out("attention_head_weights.json"), "w") as f:
-            json.dump(np.asarray(weights).tolist(), f)
-        print(json.dumps({"artifact": out("attention_head_weights.json"),
-                          "shape": list(np.asarray(weights).shape)}))
-        return 0
-
-    if experiment == "distances":
-        from .analysis import (layer_importance_distributions,
-                               pairwise_layer_distances, save_heatmap)
-
-        # per-sample forwards like the notebook's per-line loop: a multi-array
-        # .npz is one sample per array; a flat corpus splits into
-        # non-overlapping max_length windows
-        if args.corpus and args.corpus.endswith(".npz"):
-            data = np.load(args.corpus)
-            samples = [np.asarray(data[f]).reshape(-1) for f in data.files]
-            for i, s in enumerate(samples):  # _load_corpus only checked files[0]
-                if s.size and (s.max() >= cfg.vocab_size or s.min() < 0):
-                    raise SystemExit(f"npz sample {i} has token ids outside "
-                                     f"[0, {cfg.vocab_size}) — wrong tokenizer?")
-        else:
-            samples = [corpus[i:i + max_length]
-                       for i in range(0, len(corpus), max_length)]
-        samples = [s for s in samples if len(s) >= 2]
-        if args.max_chunks:
-            samples = samples[: args.max_chunks]
-        # clipping to bucketed lengths is opt-in (params key "max_compiles"):
-        # the notebook analyzes every sample at native length, and silent
-        # clipping would change the JS values it claims to reproduce
-        max_compiles = params_json.get("max_compiles")
-        dists = layer_importance_distributions(
-            cfg, params, samples, max_compiles=max_compiles)
-        matrix = pairwise_layer_distances(dists)
-        artifact = {"matrix": [[None if not np.isfinite(v) else float(v) for v in row]
-                               for row in matrix],
-                    "n_samples": len(samples), "model": args.model,
-                    "max_compiles": max_compiles,
-                    "clipped": max_compiles is not None and
-                    len({int(s.shape[0]) for s in samples}) > max_compiles}
-        with open(out("layer_distances.json"), "w") as f:
-            json.dump(artifact, f, indent=1)
-        heatmap_path = out("layer_distances.png")
-        save_heatmap(matrix, heatmap_path)
-        print(json.dumps({"artifact": out("layer_distances.json"),
-                          "heatmap": heatmap_path, "n_samples": len(samples),
-                          "layers": matrix.shape[0]}))
-        return 0
-
-    from .eval import run_token_sweep, run_initial_sweep, run_channel_sweep
-
-    if experiment == "split":
-        from .eval import run_split_eval
-
-        result = run_split_eval(
-            cfg, params, corpus,
-            cuts=params_json["cuts"],
-            hop_codecs=params_json["hop_codecs"],
-            max_length=max_length, stride=stride,
-            importance_method=params_json.get("importance_method"),
-            head_weights=load_head_weights(),
-            max_chunks=args.max_chunks,
-            window_batch=max(args.window_batch, 1))
-        with open(out("split_eval_results.json"), "w") as f:
-            json.dump(result, f, indent=1)
-        print(json.dumps(result))
-        return 0
-
-    if experiment == "initial":
-        result = run_initial_sweep(
-            cfg, params, corpus, layers_of_interest=params_json["layers_of_interest"],
-            ratios=params_json["ratios"], **common)
-    elif methods and "channel" in methods[0]:
-        result = run_channel_sweep(
-            cfg, params, corpus, methods=methods,
-            layers_of_interest=params_json["layers_of_interest"], **common)
+    if args.profile:
+        from .utils.profiling import trace as _xla_trace
+        profile_cm = _xla_trace(args.profile)
     else:
-        head_weights = load_head_weights()
-        if head_weights is None and "weighted_importance" in methods:
-            raise SystemExit("weighted_importance requires --head-weights "
-                             "(produce it with experiment: \"relevance\")")
-        result = run_token_sweep(
-            cfg, params, corpus, methods=methods or ["regular_importance"],
-            layers_of_interest=params_json["layers_of_interest"],
-            ratios=params_json["ratios"], head_weights=head_weights, **common)
+        profile_cm = contextlib.nullcontext()
 
-    with open(out("avg_ppl_results.json"), "w") as f:
-        json.dump(result.to_json(), f, indent=1)
-    print(result.table())
-    print(json.dumps({"chunks": result.chunks, "n_tokens": result.n_tokens,
-                      "wall_s": round(result.wall_s, 3),
-                      "ppl": np.round(result.ppl(), 4).tolist()}))
-    return 0
+    def _dispatch() -> int:
+        experiment = params_json.get("experiment", "")
+        methods = params_json.get("methods", [])
+        max_length = params_json.get("max_length", cfg.max_position_embeddings)
+        stride = params_json.get("stride", 32)
+        common = dict(
+            max_length=max_length, stride=stride,
+            checkpoint_path=out("sweep_checkpoint.json"),
+            checkpoint_every=args.checkpoint_every,
+            metrics_path=out("metrics.jsonl"),
+            max_chunks=args.max_chunks,
+            window_batch=max(args.window_batch, 1),
+        )
+
+        if experiment == "relevance":
+            try:
+                from .importance.relevance import run_relevance_extraction
+            except ImportError as e:
+                raise SystemExit(f"relevance extraction unavailable: {e}") from e
+
+            weights = run_relevance_extraction(
+                cfg, params, corpus, max_length=max_length, stride=stride,
+                max_chunks=args.max_chunks)
+            with open(out("attention_head_weights.json"), "w") as f:
+                json.dump(np.asarray(weights).tolist(), f)
+            print(json.dumps({"artifact": out("attention_head_weights.json"),
+                              "shape": list(np.asarray(weights).shape)}))
+            return 0
+
+        if experiment == "distances":
+            from .analysis import (layer_importance_distributions,
+                                   pairwise_layer_distances, save_heatmap)
+
+            # per-sample forwards like the notebook's per-line loop: a multi-array
+            # .npz is one sample per array; a flat corpus splits into
+            # non-overlapping max_length windows
+            if args.corpus and args.corpus.endswith(".npz"):
+                data = np.load(args.corpus)
+                samples = [np.asarray(data[f]).reshape(-1) for f in data.files]
+                for i, s in enumerate(samples):  # _load_corpus only checked files[0]
+                    if s.size and (s.max() >= cfg.vocab_size or s.min() < 0):
+                        raise SystemExit(f"npz sample {i} has token ids outside "
+                                         f"[0, {cfg.vocab_size}) — wrong tokenizer?")
+            else:
+                samples = [corpus[i:i + max_length]
+                           for i in range(0, len(corpus), max_length)]
+            samples = [s for s in samples if len(s) >= 2]
+            if args.max_chunks:
+                samples = samples[: args.max_chunks]
+            # clipping to bucketed lengths is opt-in (params key "max_compiles"):
+            # the notebook analyzes every sample at native length, and silent
+            # clipping would change the JS values it claims to reproduce
+            max_compiles = params_json.get("max_compiles")
+            dists = layer_importance_distributions(
+                cfg, params, samples, max_compiles=max_compiles)
+            matrix = pairwise_layer_distances(dists)
+            artifact = {"matrix": [[None if not np.isfinite(v) else float(v) for v in row]
+                                   for row in matrix],
+                        "n_samples": len(samples), "model": args.model,
+                        "max_compiles": max_compiles,
+                        "clipped": max_compiles is not None and
+                        len({int(s.shape[0]) for s in samples}) > max_compiles}
+            with open(out("layer_distances.json"), "w") as f:
+                json.dump(artifact, f, indent=1)
+            heatmap_path = out("layer_distances.png")
+            save_heatmap(matrix, heatmap_path)
+            print(json.dumps({"artifact": out("layer_distances.json"),
+                              "heatmap": heatmap_path, "n_samples": len(samples),
+                              "layers": matrix.shape[0]}))
+            return 0
+
+        from .eval import run_token_sweep, run_initial_sweep, run_channel_sweep
+
+        if experiment == "split":
+            from .eval import run_split_eval
+
+            result = run_split_eval(
+                cfg, params, corpus,
+                cuts=params_json["cuts"],
+                hop_codecs=params_json["hop_codecs"],
+                max_length=max_length, stride=stride,
+                importance_method=params_json.get("importance_method"),
+                head_weights=load_head_weights(),
+                max_chunks=args.max_chunks,
+                window_batch=max(args.window_batch, 1))
+            with open(out("split_eval_results.json"), "w") as f:
+                json.dump(result, f, indent=1)
+            print(json.dumps(result))
+            return 0
+
+        if experiment == "initial":
+            result = run_initial_sweep(
+                cfg, params, corpus, layers_of_interest=params_json["layers_of_interest"],
+                ratios=params_json["ratios"], **common)
+        elif methods and "channel" in methods[0]:
+            result = run_channel_sweep(
+                cfg, params, corpus, methods=methods,
+                layers_of_interest=params_json["layers_of_interest"], **common)
+        else:
+            head_weights = load_head_weights()
+            if head_weights is None and "weighted_importance" in methods:
+                raise SystemExit("weighted_importance requires --head-weights "
+                                 "(produce it with experiment: \"relevance\")")
+            result = run_token_sweep(
+                cfg, params, corpus, methods=methods or ["regular_importance"],
+                layers_of_interest=params_json["layers_of_interest"],
+                ratios=params_json["ratios"], head_weights=head_weights, **common)
+
+        with open(out("avg_ppl_results.json"), "w") as f:
+            json.dump(result.to_json(), f, indent=1)
+        print(result.table())
+        print(json.dumps({"chunks": result.chunks, "n_tokens": result.n_tokens,
+                          "wall_s": round(result.wall_s, 3),
+                          "ppl": np.round(result.ppl(), 4).tolist()}))
+        return 0
+
+    with profile_cm:
+        return _dispatch()
 
 
 if __name__ == "__main__":
