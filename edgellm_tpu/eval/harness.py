@@ -309,6 +309,22 @@ def _iter_window_groups(token_ids, max_length: int, stride: int, *,
         yield buffer
 
 
+def _run_pipelined(groups, submit, drain):
+    """Drive submit/drain one group apart: ``submit(group)`` enqueues device
+    work without host syncs and returns a record; ``drain(record)`` does the
+    host-side accumulation. Keeping exactly one record in flight lets each
+    group's conversions and checkpointing overlap the next group's device
+    compute. Used by every sweep driver."""
+    inflight = None
+    for group in groups:
+        rec = submit(group)
+        if inflight is not None:
+            drain(inflight)
+        inflight = rec
+    if inflight is not None:
+        drain(inflight)
+
+
 def _load_checkpoint(path: Optional[str], axes: dict) -> Optional[dict]:
     """Load a resume checkpoint only if it was written by the SAME sweep
     configuration — a stale checkpoint from a different axes layout must not be
@@ -446,17 +462,11 @@ def run_token_sweep(
                                  "ppl": result.ppl().tolist()})
 
     remaining = None if max_chunks is None else max_chunks - result.chunks
-    inflight = None
-    for group in _iter_window_groups(token_ids, max_length, stride,
-                                     window_batch=window_batch,
-                                     start_chunk=start_chunk,
-                                     max_count=remaining, tail_of=_scoring_tail):
-        rec = submit_group(group)
-        if inflight is not None:
-            drain_group(inflight)
-        inflight = rec
-    if inflight is not None:
-        drain_group(inflight)
+    _run_pipelined(
+        _iter_window_groups(token_ids, max_length, stride,
+                            window_batch=window_batch, start_chunk=start_chunk,
+                            max_count=remaining, tail_of=_scoring_tail),
+        submit_group, drain_group)
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,
@@ -516,15 +526,12 @@ def run_initial_sweep(
     last_ckpt = result.chunks
     remaining = None if max_chunks is None else max_chunks - result.chunks
 
-    for group in _iter_window_groups(token_ids, max_length, stride,
-                                     window_batch=window_batch,
-                                     start_chunk=start_chunk,
-                                     max_count=remaining, tail_of=_scoring_tail):
+    def submit_group(group):
         ids, targets, counts, tail = _group_arrays(group)
         ks = jnp.asarray([int(0.1 * r * ids.shape[1]) for r in ratios], jnp.int32)
         stats, hiddens = stats_fn(params, ids)
-        next_chunk = group[-1].index + 1
         reg = regular_importance(stats.col_mean)  # (L, W, S)
+        pending = []
         for l, spec in enumerate(layers_of_interest):
             if spec == "aggregate upto 2":
                 imp, codec = aggregate_upto(stats.col_mean, 2), "affine_int8_rank"
@@ -534,16 +541,29 @@ def run_initial_sweep(
                 imp, codec = reg[quant_layer], "affine_int8_top_rho"
             else:
                 imp, codec = reg[int(spec)], "affine_int8_rank"
-            nlls = _suffix_sweep(cfg, quant_layer, codec, tail)(
-                params, hiddens[quant_layer], targets, imp, fracs, ks)  # (R, W)
+            pending.append((l, _suffix_sweep(cfg, quant_layer, codec, tail)(
+                params, hiddens[quant_layer], targets, imp, fracs, ks)))  # (R, W)
+        return group, counts, pending
+
+    def drain_group(rec):
+        nonlocal next_chunk, last_ckpt
+        group, counts, pending = rec
+        for l, nlls in pending:
             # unweighted mean-of-chunk-means: each window contributes equally
             result.total_nll[l] += np.asarray(nlls, np.float64).sum(axis=1)
         result.n_tokens += counts.sum()
         result.chunks += len(group)
+        next_chunk = group[-1].index + 1
         if result.chunks - last_ckpt >= checkpoint_every:
             last_ckpt = result.chunks
             _save_checkpoint(checkpoint_path, result, next_chunk)
             _emit(metrics_path, {"chunk": group[-1].index, "ppl": result.ppl().tolist()})
+
+    _run_pipelined(
+        _iter_window_groups(token_ids, max_length, stride,
+                            window_batch=window_batch, start_chunk=start_chunk,
+                            max_count=remaining, tail_of=_scoring_tail),
+        submit_group, drain_group)
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,
@@ -588,24 +608,33 @@ def run_channel_sweep(
     next_chunk = start_chunk
     last_ckpt = result.chunks
     remaining = None if max_chunks is None else max_chunks - result.chunks
-    for group in _iter_window_groups(token_ids, max_length, stride,
-                                     window_batch=window_batch,
-                                     start_chunk=start_chunk,
-                                     max_count=remaining, tail_of=_scoring_tail):
+    def submit_group(group):
         ids, targets, counts, tail = _group_arrays(group)
         hiddens = fwd(params, ids)  # (L, W, S, D)
-        next_chunk = group[-1].index + 1
-        for m, method in enumerate(methods):
-            for l, layer in enumerate(layers_of_interest):
-                nlls = _suffix_channel(cfg, int(layer), method, tail)(
-                    params, hiddens[layer], targets)  # (W,)
-                result.total_nll[m, l] += np.asarray(nlls, np.float64) @ counts
+        pending = [(m, l, _suffix_channel(cfg, int(layer), method, tail)(
+                       params, hiddens[layer], targets))  # (W,)
+                   for m, method in enumerate(methods)
+                   for l, layer in enumerate(layers_of_interest)]
+        return group, counts, pending
+
+    def drain_group(rec):
+        nonlocal next_chunk, last_ckpt
+        group, counts, pending = rec
+        for m, l, nlls in pending:
+            result.total_nll[m, l] += np.asarray(nlls, np.float64) @ counts
         result.n_tokens += counts.sum()
         result.chunks += len(group)
+        next_chunk = group[-1].index + 1
         if result.chunks - last_ckpt >= checkpoint_every:
             last_ckpt = result.chunks
             _save_checkpoint(checkpoint_path, result, next_chunk)
             _emit(metrics_path, {"chunk": group[-1].index, "ppl": result.ppl().tolist()})
+
+    _run_pipelined(
+        _iter_window_groups(token_ids, max_length, stride,
+                            window_batch=window_batch, start_chunk=start_chunk,
+                            max_count=remaining, tail_of=_scoring_tail),
+        submit_group, drain_group)
     result.wall_s = time.monotonic() - t0
     _save_checkpoint(checkpoint_path, result, next_chunk)
     _emit(metrics_path, {"final": True, "chunks": result.chunks,

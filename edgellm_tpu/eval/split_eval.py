@@ -26,7 +26,7 @@ from ..models.transformer import nll_from_logits, run_layers_from_ids
 from ..importance import importance_per_layer
 from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
 from ..codecs.packing import WireCodec, selective_int4
-from .harness import _iter_window_groups
+from .harness import _iter_window_groups, _run_pipelined
 
 
 def parse_hop_codec(spec: str) -> object:
@@ -115,8 +115,7 @@ def run_split_eval(
     bytes_cache: dict = {}
     t0 = time.monotonic()
 
-    def process_group(group):
-        nonlocal total_nll, n_tokens, chunks, fwd_tokens
+    def submit_group(group):
         n_real = len(group)
         counts = [c.num_loss_tokens for c in group]
         # pad a partial group up to the data-axis size with repeated windows;
@@ -133,11 +132,15 @@ def run_split_eval(
                        else None
                        for cut, need in zip(split.cuts, needs_imp)]
         logits = rt.forward(placed, ids, hop_importance=hop_imp)
-        nlls = np.asarray(nll_from_logits(logits, targets, per_example=True),
-                          np.float64)
-        total_nll += float(nlls @ np.asarray(counts, np.float64))
+        nlls = nll_from_logits(logits, targets, per_example=True)
+        return group, n_real, counts, ids.shape, nlls
+
+    def drain_group(rec):
+        nonlocal total_nll, n_tokens, chunks, fwd_tokens
+        group, n_real, counts, (w, s_chunk), nlls = rec
+        total_nll += float(np.asarray(nlls, np.float64)
+                           @ np.asarray(counts, np.float64))
         n_tokens += sum(counts)
-        w, s_chunk = ids.shape
         fwd_tokens += w * s_chunk
         key = (w, s_chunk)
         if key not in bytes_cache:  # payloads are shape-determined
@@ -148,10 +151,10 @@ def run_split_eval(
         if progress:
             progress(group[-1].index)
 
-    for group in _iter_window_groups(token_ids, max_length, stride,
-                                     window_batch=window_batch,
-                                     max_count=max_chunks):
-        process_group(group)
+    _run_pipelined(
+        _iter_window_groups(token_ids, max_length, stride,
+                            window_batch=window_batch, max_count=max_chunks),
+        submit_group, drain_group)
     wall = time.monotonic() - t0
 
     seq = min(max_length, len(np.asarray(token_ids).reshape(-1)))
