@@ -221,7 +221,16 @@ def main(argv=None) -> int:
 
         if experiment == "split":
             from .eval import run_split_eval
+            from .parallel import make_stage_mesh
 
+            # optional extra mesh axes: "n_data" shards the window batch
+            # (window_batch must be a multiple), "n_model" tensor-parallelizes
+            # each stage; default is one device per pipeline stage
+            mesh = None
+            if params_json.get("n_data", 1) > 1 or params_json.get("n_model", 1) > 1:
+                mesh = make_stage_mesh(len(params_json["cuts"]) + 1,
+                                       n_data=params_json.get("n_data", 1),
+                                       n_model=params_json.get("n_model", 1))
             result = run_split_eval(
                 cfg, params, corpus,
                 cuts=params_json["cuts"],
@@ -230,6 +239,7 @@ def main(argv=None) -> int:
                 importance_method=params_json.get("importance_method"),
                 head_weights=load_head_weights(),
                 max_chunks=args.max_chunks,
+                mesh=mesh,
                 window_batch=max(args.window_batch, 1))
             with open(out("split_eval_results.json"), "w") as f:
                 json.dump(result, f, indent=1)
