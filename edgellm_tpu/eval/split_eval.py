@@ -75,8 +75,18 @@ def run_split_eval(
     progress=None,
     time_hops: bool = True,
     window_batch: int = 1,
+    n_seq: int = 1,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
+
+    ``n_seq > 1`` selects the composed stage x seq runtime
+    (:class:`~edgellm_tpu.parallel.ring.SplitRingRuntime`): within every
+    pipeline stage the sequence is ring-sharded over a "seq" mesh axis and each
+    boundary hop moves the local per-token-compressed shard — the long-context
+    path, where no device ever holds the full sequence at a cut. Requires
+    per-token (batch-invariant) hop codecs; windows whose length is not a
+    multiple of ``n_seq`` are right-padded with masked (-100) positions, which
+    is exact under causal attention.
 
     ``hop_codecs`` entries may be names, codec-spec strings, or WireCodec
     instances. Token-selective hops take their importance from
@@ -93,9 +103,16 @@ def run_split_eval(
     """
     codecs = [parse_hop_codec(c) if isinstance(c, str) else c for c in hop_codecs]
     split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(codecs))
-    if mesh is None:
-        mesh = make_stage_mesh(split.n_stages)
-    rt = SplitRuntime(cfg, split, mesh)
+    if n_seq > 1:
+        from ..parallel.ring import SplitRingRuntime, make_sp_stage_mesh
+
+        if mesh is None:
+            mesh = make_sp_stage_mesh(split.n_stages, n_seq)
+        rt = SplitRingRuntime(cfg, split.cuts, codecs, mesh)
+    else:
+        if mesh is None:
+            mesh = make_stage_mesh(split.n_stages)
+        rt = SplitRuntime(cfg, split, mesh)
     placed = rt.place_params(params)
     needs_imp = [c.needs_importance for c in rt.codecs]
     if any(needs_imp) and importance_method is None:
@@ -104,7 +121,7 @@ def run_split_eval(
     imp_fn = (_importance_fn(cfg, importance_method)
               if any(needs_imp) and importance_method is not None else None)
     hw = None if head_weights is None else jnp.asarray(head_weights)
-    n_data = mesh.shape["data"]
+    n_data = dict(mesh.shape).get("data", 1)
     if window_batch % n_data:
         raise ValueError(f"window_batch {window_batch} must be a multiple of the "
                          f"mesh data axis size {n_data}")
@@ -123,15 +140,23 @@ def run_split_eval(
         while len(group) % n_data:
             group = group + [group[-1]]
             counts = counts + [0]
-        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
-        targets = jnp.asarray(np.concatenate([c.target_ids for c in group]))
-        hop_imp = None
+        ids = np.concatenate([c.input_ids for c in group])
+        targets = np.concatenate([c.target_ids for c in group])
+        if n_seq > 1 and ids.shape[1] % n_seq:
+            # right-pad to a seq-shardable length; padded positions are masked
+            # (-100) and, under causal attention, invisible to scored ones
+            pad = n_seq - ids.shape[1] % n_seq
+            ids = np.pad(ids, ((0, 0), (0, pad)))
+            targets = np.pad(targets, ((0, 0), (0, pad)), constant_values=-100)
+        ids, targets = jnp.asarray(ids), jnp.asarray(targets)
         if imp_fn is not None:
             imp = imp_fn(params, ids, hw)  # (L, W, S)
             hop_imp = [(imp[cut] if len(group) > 1 else imp[cut, 0]) if need
                        else None
                        for cut, need in zip(split.cuts, needs_imp)]
-        logits = rt.forward(placed, ids, hop_importance=hop_imp)
+            logits = rt.forward(placed, ids, hop_importance=hop_imp)
+        else:
+            logits = rt.forward(placed, ids)
         nlls = nll_from_logits(logits, targets, per_example=True)
         return group, n_real, counts, ids.shape, nlls
 
@@ -178,5 +203,6 @@ def run_split_eval(
         "mesh": dict(mesh.shape),
     }
     if time_hops and chunks:
-        result["per_hop_ms"] = rt.time_hops(1, seq)
+        t_seq = seq if n_seq <= 1 else seq + (-seq) % n_seq
+        result["per_hop_ms"] = rt.time_hops(1, t_seq)
     return result

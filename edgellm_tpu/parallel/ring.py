@@ -287,6 +287,30 @@ class SplitRingRuntime:
 
         return fn
 
+    def hop_bytes(self, batch: int, seq: int) -> list:
+        """Measured payload bytes per hop for one (batch, seq, D) activation
+        (sum over the ``n_seq`` local-shard payloads; see
+        ``split.hop_payload_bytes``)."""
+        from .split import hop_payload_bytes
+
+        return hop_payload_bytes(self.codecs, self.cfg, batch, seq)
+
+    def bytes_per_token(self, seq: int) -> list:
+        """Per-hop boundary bytes per token (the BASELINE.json metric)."""
+        return [b / seq for b in self.hop_bytes(1, seq)]
+
+    def time_hops(self, batch: int, seq: int, iters: int = 20) -> list:
+        """Per-hop transfer time (ms) with the probe activation seq-sharded the
+        way the runtime's hops actually move it (each device sends its local
+        shard in parallel)."""
+        from .split import measure_hop_times
+
+        if seq % self.mesh.shape["seq"]:
+            raise ValueError(f"seq {seq} not divisible by the seq axis "
+                             f"({self.mesh.shape['seq']})")
+        return measure_hop_times(self.mesh, self.codecs, self.cfg, batch, seq,
+                                 iters=iters, hidden_spec=P(None, "seq"))
+
     def forward(self, placed_params: dict, input_ids) -> jnp.ndarray:
         """ids (B, S) -> full fp32 logits; layers stage-split, sequence
         ring-sharded, boundary hops carry packed per-token payload shards."""

@@ -110,6 +110,53 @@ def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
         jnp.where(idx == n_stages - 1, hidden, jnp.zeros_like(hidden)), axis_name)
 
 
+def hop_payload_bytes(codecs, cfg, batch: int, seq: int) -> list:
+    """Measured payload bytes per hop for one (batch, seq, D) boundary
+    activation — the BASELINE.json metric's numerator, shared by every runtime.
+    (For the stage x seq runtime each device moves its local sequence shard;
+    per-token codecs' payloads are sequence-additive, so the total equals one
+    full-sequence encode.)"""
+    shape = (batch, seq, cfg.hidden_size)
+    return [c.payload_bytes(shape) for c in codecs]
+
+
+def measure_hop_times(mesh, codecs, cfg, batch: int, seq: int, *,
+                      iters: int = 20, hidden_spec: P = P()) -> list:
+    """Per-hop boundary-transfer time (ms): encode -> ppermute over "stage" ->
+    decode, isolated from stage compute. ``hidden_spec`` places the probe
+    activation on the mesh (replicated for the plain split runtime,
+    seq-sharded ``P(None, "seq")`` for the stage x seq runtime, which times the
+    local-shard payloads its hops actually move)."""
+    from ..utils.profiling import timed
+
+    results = []
+    hidden = jax.random.normal(
+        jax.random.key(0), (batch, seq, cfg.hidden_size), jnp.float32)
+    # match forward's wire format: batched windows ship per-row importance
+    # (B x S order side channel), so time that payload, not the shared one
+    imp = (jnp.arange(seq, dtype=jnp.float32) if batch == 1 else
+           jnp.broadcast_to(jnp.arange(seq, dtype=jnp.float32), (batch, seq)))
+    for s, codec in enumerate(codecs):
+
+        def hop_body(h):
+            idx = jax.lax.axis_index("stage")
+            if codec.needs_importance:
+                payload = codec.encode(h, imp)
+            else:
+                payload = codec.encode(h)
+            moved = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, "stage", [(s, s + 1)]), payload)
+            decoded = codec.decode(moved)
+            return jax.lax.psum(
+                jnp.where(idx == s + 1, decoded, jnp.zeros_like(decoded)), "stage")
+
+        fn = jax.jit(shard_map(hop_body, mesh=mesh, in_specs=hidden_spec,
+                               out_specs=hidden_spec, check_vma=False))
+        sec, _ = timed(fn, hidden, warmup=1, iters=iters)
+        results.append(sec * 1000.0)
+    return results
+
+
 @dataclasses.dataclass(frozen=True)
 class SplitConfig:
     """Where the model is cut and what crosses each cut.
@@ -328,8 +375,7 @@ class SplitRuntime:
 
     def hop_bytes(self, batch: int, seq: int) -> list:
         """Measured payload bytes per hop for one (batch, seq, D) activation."""
-        shape = (batch, seq, self.cfg.hidden_size)
-        return [c.payload_bytes(shape) for c in self.codecs]
+        return hop_payload_bytes(self.codecs, self.cfg, batch, seq)
 
     def bytes_per_token(self, seq: int) -> list:
         """Per-hop boundary bytes per token (the BASELINE.json metric)."""
@@ -341,32 +387,5 @@ class SplitRuntime:
         compute so the observability numbers attribute wire cost separately
         (the reference has no transfer at all to time — SURVEY.md section 5).
         """
-        from ..utils.profiling import timed
-
-        results = []
-        mesh = self.mesh
-        hidden = jax.random.normal(
-            jax.random.key(0), (batch, seq, self.cfg.hidden_size), jnp.float32)
-        # match forward's wire format: batched windows ship per-row importance
-        # (B x S order side channel), so time that payload, not the shared one
-        imp = (jnp.arange(seq, dtype=jnp.float32) if batch == 1 else
-               jnp.broadcast_to(jnp.arange(seq, dtype=jnp.float32), (batch, seq)))
-        for s, codec in enumerate(self.codecs):
-
-            def hop_body(h):
-                idx = jax.lax.axis_index("stage")
-                if codec.needs_importance:
-                    payload = codec.encode(h, imp)
-                else:
-                    payload = codec.encode(h)
-                moved = jax.tree_util.tree_map(
-                    lambda a: jax.lax.ppermute(a, "stage", [(s, s + 1)]), payload)
-                decoded = codec.decode(moved)
-                return jax.lax.psum(
-                    jnp.where(idx == s + 1, decoded, jnp.zeros_like(decoded)), "stage")
-
-            fn = jax.jit(shard_map(hop_body, mesh=mesh, in_specs=P(),
-                                   out_specs=P(), check_vma=False))
-            sec, _ = timed(fn, hidden, warmup=1, iters=iters)
-            results.append(sec * 1000.0)
-        return results
+        return measure_hop_times(self.mesh, self.codecs, self.cfg, batch, seq,
+                                 iters=iters)

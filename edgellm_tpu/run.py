@@ -227,6 +227,12 @@ def main(argv=None) -> int:
             # (window_batch must be a multiple), "n_model" tensor-parallelizes
             # each stage; default is one device per pipeline stage
             mesh = None
+            if params_json.get("n_seq", 1) > 1 and (
+                    params_json.get("n_data", 1) > 1
+                    or params_json.get("n_model", 1) > 1):
+                raise SystemExit(
+                    "n_seq composes the pipeline with sequence sharding only; "
+                    "combining it with n_data/n_model is not supported")
             if params_json.get("n_data", 1) > 1 or params_json.get("n_model", 1) > 1:
                 mesh = make_stage_mesh(len(params_json["cuts"]) + 1,
                                        n_data=params_json.get("n_data", 1),
@@ -240,7 +246,8 @@ def main(argv=None) -> int:
                 head_weights=load_head_weights(),
                 max_chunks=args.max_chunks,
                 mesh=mesh,
-                window_batch=max(args.window_batch, 1))
+                window_batch=max(args.window_batch, 1),
+                n_seq=params_json.get("n_seq", 1))
             with open(out("split_eval_results.json"), "w") as f:
                 json.dump(result, f, indent=1)
             print(json.dumps(result))

@@ -18,6 +18,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+# persistent compilation cache: the suite compiles hundreds of executables and
+# reruns are dominated by recompilation; cache them across runs
+_cache_dir = os.environ.get("EDGELLM_JAX_CACHE",
+                            os.path.join(os.path.dirname(__file__), ".jax_cache"))
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np
 import pytest
 

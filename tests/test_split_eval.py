@@ -110,3 +110,20 @@ def test_window_batch_not_multiple_of_data_axis_raises(setup):
         run_split_eval(CFG, params, corpus, cuts=[2], hop_codecs=["fp32"],
                        max_length=16, stride=8, window_batch=3,
                        mesh=make_stage_mesh(2, n_data=2), time_hops=False)
+
+
+def test_ring_split_eval_matches_plain(setup):
+    """n_seq > 1 (stage x seq ring runtime) reproduces the plain split eval,
+    including a window length that needs right-padding to shard."""
+    from edgellm_tpu.parallel import make_stage_mesh
+
+    params, corpus = setup
+    kw = dict(cuts=[2], hop_codecs=["int8_per_token"], max_length=18, stride=9,
+              time_hops=False)
+    want = run_split_eval(CFG, params, corpus[:100],
+                          mesh=make_stage_mesh(2), **kw)
+    got = run_split_eval(CFG, params, corpus[:100], n_seq=3, **kw)
+    assert got["chunks"] == want["chunks"]
+    assert got["n_tokens"] == want["n_tokens"]
+    np.testing.assert_allclose(got["ppl"], want["ppl"], rtol=2e-5)
+    assert got["mesh"] == {"stage": 2, "seq": 3}
