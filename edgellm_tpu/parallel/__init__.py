@@ -7,6 +7,16 @@ each pipeline stage's layer parameters live on their own device of a
 devices as a *packed, quantized* payload via ``lax.ppermute`` inside
 ``shard_map`` — over ICI on a real TPU slice, over host memory on the spoofed
 CPU mesh the tests use.
+
+Multi-host scaling: every runtime here is written against ``jax.devices()``
+and a named ``Mesh``, so the same code runs across hosts once
+``jax.distributed.initialize()`` has joined them — ``jax.devices()`` then
+spans the full slice/pod and the mesh builders lay stages/seq shards over it.
+Axis layout determines the fabric each collective rides: keep the "stage" and
+"seq" axes within a slice so the per-cut ``ppermute`` and the ring's K/V
+rotation stay on ICI, and put the embarrassingly-parallel "data" axis
+outermost so any cross-slice (DCN) edge only carries the per-window NLL
+reductions, never per-token activation traffic.
 """
 from .split import SplitConfig, SplitRuntime, make_stage_mesh
 from .ring import (ring_attention, forward_sp, make_seq_mesh,
