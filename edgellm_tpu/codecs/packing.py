@@ -87,7 +87,7 @@ class WireCodec:
         spec = jax.ShapeDtypeStruct(hidden_shape, dtype)
         if self.needs_importance:
             # batch > 1 implies per-row importance (per-row ordering/scale wire
-            # format — the order side channel is B x S, not S)
+            # format — the low-index side channel is B x k, not k)
             b, s = hidden_shape[0], hidden_shape[1]
             imp = jax.ShapeDtypeStruct((s,) if b == 1 else (b, s), jnp.float32)
             return _nbytes(jax.eval_shape(self.encode, spec, imp))
@@ -233,9 +233,13 @@ def selective_int4(ratio: float, high: str = "bf16", *,
     precision (fp16/bf16 is the reference's notional transfer baseline, fp32 is
     bit-exact vs the in-place simulation). The wire carries two COMPACTED
     buffers — ``k = floor(ratio*S)`` is static, so the low/high split has static
-    shapes — plus the token ordering needed to reassemble on the far side
-    (int32; the reference's analytic byte counts ignore this side channel, the
-    measured ``payload_bytes`` here does not).
+    shapes — plus the side channel needed to reassemble on the far side: ONLY
+    the ``k`` low-token indices, as int16 (S <= 32767). The high tokens are
+    shipped in position-ascending order, so their placement is derived on the
+    decode side as the sorted complement of the low-index set — no full
+    permutation crosses the wire (2k bytes vs the naive 4S; the reference's
+    analytic byte counts ignore the side channel entirely, the measured
+    ``payload_bytes`` here does not).
 
     ``encode(hidden, importance)``; the split runtime threads the importance
     vector to importance-carrying hops. ``importance`` may be a shared (S,)
@@ -258,6 +262,9 @@ def selective_int4(ratio: float, high: str = "bf16", *,
 
     def encode(h, importance):
         b, s, d = h.shape
+        if s > 32767:
+            raise ValueError(f"selective_int4 int16 index side channel needs "
+                             f"S <= 32767, got {s}")
         k = int(ratio * s)
         importance = jnp.asarray(importance)
         if importance.ndim == 2:  # per-row ordering + scale
@@ -267,23 +274,27 @@ def selective_int4(ratio: float, high: str = "bf16", *,
             max_val = (jnp.max(jnp.abs(low), axis=(1, 2)) if k
                        else jnp.zeros((b,), jnp.float32))
             safe = jnp.where(max_val > 0, max_val, 1.0)  # (B,)
+            # high tokens ship position-ascending: their placement is implied
+            # by the low-index set, so only the k low indices cross the wire
+            high_pos = jnp.sort(order[:, k:], axis=-1)
             return {
                 "low": (quant_pack(low, safe[:, None, None]) if k
                         else jnp.zeros((b, 0, d // 2), jnp.uint8)),
                 "scale": safe,
-                "high": h[rows, order[:, k:]].astype(high_dtype),
-                "order": order.astype(jnp.int32),
+                "high": h[rows, high_pos].astype(high_dtype),
+                "order": order[:, :k].astype(jnp.int16),
             }
         order = jnp.argsort(importance)  # ascending, stable — least important first
-        low_idx, high_idx = order[:k], order[k:]
+        low_idx = order[:k]
+        high_pos = jnp.sort(order[k:])  # position-ascending (see per-row note)
         low = jnp.take(h, low_idx, axis=1)  # (B, k, D)
         max_val = jnp.max(jnp.abs(low)) if k else jnp.asarray(0.0)
         safe = jnp.where(max_val > 0, max_val, 1.0)
         return {
             "low": quant_pack(low, safe) if k else jnp.zeros((b, 0, d // 2), jnp.uint8),
             "scale": safe[None],
-            "high": jnp.take(h, high_idx, axis=1).astype(high_dtype),
-            "order": order.astype(jnp.int32),
+            "high": jnp.take(h, high_pos, axis=1).astype(high_dtype),
+            "order": low_idx.astype(jnp.int16),
         }
 
     def decode(p):
@@ -291,18 +302,23 @@ def selective_int4(ratio: float, high: str = "bf16", *,
         k = p["low"].shape[1]
         d = p["low"].shape[2] * 2 if k else p["high"].shape[2]
         s = k + p["high"].shape[1]
-        order = p["order"]
         out = jnp.zeros((b, s, d), jnp.float32)
-        if order.ndim == 2:  # per-row
+        if p["order"].ndim == 2:  # per-row
+            low_idx = p["order"].astype(jnp.int32)  # (B, k)
             rows = jnp.arange(b)[:, None]
+            mask = jnp.ones((b, s), bool).at[rows, low_idx].set(False)
+            high_pos = jax.vmap(lambda m: jnp.nonzero(m, size=s - k)[0])(mask)
             low = unpack_dequant(p["low"], p["scale"][:, None, None]) \
                 if k else jnp.zeros((b, 0, d), jnp.float32)
-            out = out.at[rows, order[:, :k]].set(low)
-            return out.at[rows, order[:, k:]].set(p["high"].astype(jnp.float32))
+            out = out.at[rows, low_idx].set(low)
+            return out.at[rows, high_pos].set(p["high"].astype(jnp.float32))
+        low_idx = p["order"].astype(jnp.int32)  # (k,)
+        mask = jnp.ones((s,), bool).at[low_idx].set(False)
+        high_pos = jnp.nonzero(mask, size=s - k)[0]  # sorted complement
         low = unpack_dequant(p["low"], p["scale"][0]) \
             if k else jnp.zeros((b, 0, d), jnp.float32)
-        out = out.at[:, order[:k], :].set(low)
-        return out.at[:, order[k:], :].set(p["high"].astype(jnp.float32))
+        out = out.at[:, low_idx, :].set(low)
+        return out.at[:, high_pos, :].set(p["high"].astype(jnp.float32))
 
     return WireCodec(f"selective_int4_r{ratio}_{high}{name_suffix}", encode, decode,
                      batch_invariant=False, needs_importance=True)

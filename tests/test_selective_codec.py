@@ -48,10 +48,29 @@ def test_payload_bytes_scale_with_ratio():
     full = selective_int4(1.0, high="bf16").payload_bytes((1, S, D))
     none = selective_int4(0.0, high="bf16").payload_bytes((1, S, D))
     half = selective_int4(0.5, high="bf16").payload_bytes((1, S, D))
-    order_bytes = S * 4
-    assert none == S * D * 2 + order_bytes + 4 + 0  # all bf16 + order + scale
-    assert full == S * D // 2 + order_bytes + 4  # all packed int4
+    # side channel = k int16 low indices only (high placement is the sorted
+    # complement, derived on decode) — 2k bytes, zero at ratio 0
+    assert none == S * D * 2 + 4  # all bf16 + scale, NO side channel
+    assert full == S * D // 2 + S * 2 + 4  # all packed int4 + full low-index set
+    assert half == S * D // 4 + (S // 2) * D * 2 + (S // 2) * 2 + 4
     assert none > half > full
+
+
+def test_order_side_channel_is_int16_low_only(rng):
+    h = jnp.asarray(rng.normal(size=(1, 16, 32)).astype(np.float32))
+    imp = jnp.asarray(rng.random(16).astype(np.float32))
+    p = selective_int4(0.25, "bf16").encode(h, imp)
+    assert p["order"].dtype == jnp.int16 and p["order"].shape == (4,)
+    pr = selective_int4(0.25, "bf16").encode(
+        jnp.tile(h, (3, 1, 1)), jnp.asarray(rng.random((3, 16)).astype(np.float32)))
+    assert pr["order"].dtype == jnp.int16 and pr["order"].shape == (3, 4)
+
+
+def test_seq_over_int16_limit_raises():
+    codec = selective_int4(0.5, "bf16")
+    with pytest.raises(ValueError, match="32767"):
+        codec.encode(jnp.zeros((1, 32768, 2), jnp.float32),
+                     jnp.zeros((32768,), jnp.float32))
 
 
 def test_split_runtime_with_selective_hop(data):
