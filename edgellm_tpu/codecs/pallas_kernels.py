@@ -275,7 +275,8 @@ def _chan_int8_encode_kernel(x_ref, scale_ref, q_ref):
 
 
 def _chan_int8_decode_kernel(q_ref, scale_ref, out_ref):
-    out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[:] * jnp.float32(1.0 / 127.0)
+    # divide (not reciprocal-multiply): matches the jnp twin bit-for-bit
+    out_ref[:] = q_ref[:].astype(jnp.float32) * scale_ref[:] / 127.0
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

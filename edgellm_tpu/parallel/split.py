@@ -137,6 +137,14 @@ def measure_hop_times(mesh, codecs, cfg, batch: int, seq: int, *,
     imp = (jnp.arange(seq, dtype=jnp.float32) if batch == 1 else
            jnp.broadcast_to(jnp.arange(seq, dtype=jnp.float32), (batch, seq)))
     for s, codec in enumerate(codecs):
+        if codec.needs_importance and hidden_spec != P():
+            # the closure-captured probe importance is full-length; a sharded
+            # hidden would pair a shard-local activation with full-length
+            # importance at trace time. SplitRingRuntime rejects non-
+            # batch-invariant codecs, so no caller hits this today.
+            raise NotImplementedError(
+                f"measure_hop_times: importance-carrying codec {codec.name!r} "
+                f"is incompatible with a sharded hidden_spec ({hidden_spec})")
 
         def hop_body(h):
             idx = jax.lax.axis_index("stage")

@@ -1,0 +1,27 @@
+"""The on-silicon Pallas probe's parity logic, exercised on CPU (interpret
+mode). On the real chip ``bench.py`` runs the same probe with timing and embeds
+it as the bench line's ``"pallas"`` block — this pins the comparison machinery
+(ulp math, leaf checks, codec pairing) without a TPU."""
+import numpy as np
+
+from edgellm_tpu.tools.pallas_probe import PROBE_CODECS, _ulp_diff, probe_all
+
+
+def test_ulp_diff():
+    a = np.float32(1.0)
+    assert _ulp_diff(np.asarray([a]), np.asarray([np.nextafter(a, 2.0)])) == 1
+    assert _ulp_diff(np.asarray([a]), np.asarray([a])) == 0
+    # sign crossing: -eps to +eps is two representable steps apart at most
+    tiny = np.float32(1e-45)
+    assert _ulp_diff(np.asarray([-tiny]), np.asarray([tiny])) == 2
+    assert _ulp_diff(np.zeros((0,), np.float32), np.zeros((0,), np.float32)) == 0
+
+
+def test_probe_all_parity_small():
+    out = probe_all(timing=False, batch=2, seq=32, dim=64)
+    assert out["interpret"] is True
+    assert [c["codec"] for c in out["codecs"]] == list(PROBE_CODECS)
+    for c in out["codecs"]:
+        assert c["encode_max_ulp"] <= 2 and c["decode_max_ulp"] <= 2
+        assert c["int_leaves_bit_identical"] >= 1
+        assert "encode_gbps" not in c  # timing disabled off-chip
