@@ -49,6 +49,33 @@ from .windowing import sliding_windows
 TOKEN_CODECS = ("int4_token_select", "affine_int8_rank", "affine_int8_top_rho")
 
 
+def is_oom_error(e: BaseException) -> bool:
+    """True for XLA device-memory exhaustion (any backend's phrasing)."""
+    msg = str(e)
+    return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            or "out of memory" in msg)
+
+
+def run_with_oom_backoff(run: Callable[[int], object], window_batch: int,
+                         min_window_batch: int = 1, on_backoff=None):
+    """Call ``run(window_batch)``, halving the batch on RESOURCE_EXHAUSTED
+    instead of dying -> (result, effective_window_batch).
+
+    ``run`` must be restartable (the sweep drivers are: each call builds fresh
+    accumulators, and with a ``checkpoint_path`` a retried call resumes exactly
+    from the last checkpoint, so work done before the OOM is kept)."""
+    wb = window_batch
+    while True:
+        try:
+            return run(wb), wb
+        except Exception as e:  # XlaRuntimeError isn't a stable public type
+            if not is_oom_error(e) or wb <= min_window_batch:
+                raise
+            wb = max(wb // 2, min_window_batch)
+            if on_backoff:
+                on_backoff(wb, e)
+
+
 def _apply_token_codec(codec: str, hidden, importance, ratio, k):
     """Quantize ``hidden`` (B, S, D) at the boundary under one token codec.
 
@@ -340,20 +367,88 @@ def _load_checkpoint(path: Optional[str], axes: dict) -> Optional[dict]:
     return None
 
 
-def _save_checkpoint(path: Optional[str], result: SweepResult, next_chunk: int):
-    if not path:
+def _save_checkpoint_state(path: Optional[str], state: dict):
+    """Atomic JSON checkpoint write (tmp + rename), shared by every resumable
+    driver (sweeps, split eval, relevance). Multi-host runs write from process
+    0 only (all processes hold identical accumulators under SPMD); resume
+    expects the checkpoint on storage every process can read."""
+    if not path or jax.process_index() != 0:
         return
-    state = {"next_chunk": next_chunk, "axes": result.axes,
-             "total_nll": result.total_nll.tolist(),
-             "n_tokens": result.n_tokens, "chunks": result.chunks}
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(state, f)
     os.replace(tmp, path)
 
 
+def fetch_global(x) -> np.ndarray:
+    """Host-fetch a device array that may be sharded across PROCESSES (the
+    data axis of a multi-host split mesh): single-process arrays go straight
+    to numpy; process-spanning arrays are allgathered first (np.asarray on a
+    non-addressable jax.Array raises)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)
+
+
+class ResumableDriver:
+    """The shared resumable-driver scaffold: axes-validated checkpoint load,
+    atomic save, cumulative wall-clock across resumes, and the
+    ``checkpoint_every`` trigger. New drivers should use this rather than
+    re-implementing the bookkeeping (split eval and relevance do; the older
+    sweep drivers predate it).
+
+    ``state`` holds the loaded checkpoint dict (None on a fresh start) for
+    driver-specific fields; ``save(extra)`` persists them alongside the
+    common ones.
+    """
+
+    def __init__(self, checkpoint_path: Optional[str], axes: dict,
+                 checkpoint_every: int):
+        self.path, self.axes, self.every = checkpoint_path, axes, checkpoint_every
+        self.state = _load_checkpoint(checkpoint_path, axes)
+        loaded = self.state or {}
+        self.prior_wall = loaded.get("wall_s", 0.0)
+        self.start_chunk = loaded.get("next_chunk", 0)
+        self.chunks = loaded.get("chunks", 0)
+        self.next_chunk = self.start_chunk
+        self._last_ckpt = self.chunks
+        self._t0 = time.monotonic()
+
+    def wall(self) -> float:
+        """Cumulative seconds across every resumed run (honest rates)."""
+        return self.prior_wall + time.monotonic() - self._t0
+
+    def save(self, extra: dict):
+        _save_checkpoint_state(self.path, {
+            "next_chunk": self.next_chunk, "axes": self.axes,
+            "chunks": self.chunks, "wall_s": self.wall(), **extra})
+
+    def advance(self, group, count: Optional[int] = None) -> bool:
+        """Account one drained window group -> True when a checkpoint is due.
+        ``count`` overrides the chunk increment (e.g. to exclude batch-pad
+        repeat windows, which are not resume chunks)."""
+        self.chunks += len(group) if count is None else count
+        self.next_chunk = group[-1].index + 1
+        if self.chunks - self._last_ckpt >= self.every:
+            self._last_ckpt = self.chunks
+            return True
+        return False
+
+    def remaining(self, max_chunks: Optional[int]) -> Optional[int]:
+        return None if max_chunks is None else max_chunks - self.chunks
+
+
+def _save_checkpoint(path: Optional[str], result: SweepResult, next_chunk: int):
+    _save_checkpoint_state(path, {
+        "next_chunk": next_chunk, "axes": result.axes,
+        "total_nll": result.total_nll.tolist(),
+        "n_tokens": result.n_tokens, "chunks": result.chunks})
+
+
 def _emit(metrics_path: Optional[str], record: dict):
-    if not metrics_path:
+    if not metrics_path or jax.process_index() != 0:
         return
     with open(metrics_path, "a") as f:
         f.write(json.dumps(record) + "\n")

@@ -10,11 +10,16 @@ Qwen2 (configs[1]), importance-guided mixed 4/8-bit (configs[2]), and the
 
 Byte accounting comes from the split runtime's measured payload sizes; the
 result records bytes/token per hop alongside the PPL.
+
+Durability matches the simulate sweep drivers (and the reference's
+partial-sum checkpointing, ``Qwen2-0.5B/main.py:184-192``): an axes-validated
+JSON checkpoint written every ``checkpoint_every`` chunks enables EXACT resume
+— identical final PPL and measured byte totals — plus an append-only
+``metrics.jsonl`` stream.
 """
 from __future__ import annotations
 
 import functools
-import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -26,7 +31,8 @@ from ..models.transformer import nll_from_logits, run_layers_from_ids
 from ..importance import importance_per_layer
 from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
 from ..codecs.packing import WireCodec, selective_int4
-from .harness import _iter_window_groups, _run_pipelined
+from .harness import (ResumableDriver, _emit, _iter_window_groups,
+                      _run_pipelined, fetch_global)
 
 
 def parse_hop_codec(spec: str) -> object:
@@ -76,6 +82,9 @@ def run_split_eval(
     time_hops: bool = True,
     window_batch: int = 1,
     n_seq: int = 1,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1000,
+    metrics_path: Optional[str] = None,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
 
@@ -126,14 +135,41 @@ def run_split_eval(
         raise ValueError(f"window_batch {window_batch} must be a multiple of the "
                          f"mesh data axis size {n_data}")
 
-    total_nll, n_tokens, chunks = 0.0, 0.0, 0
+    # resume axes: the USER-LEVEL split spec (requested codec specs, not the
+    # runtime's possibly Pallas-substituted names, so a checkpoint written on a
+    # CPU host resumes on TPU and vice versa)
+    axes = {
+        "model": {"family": cfg.family, "num_layers": cfg.num_layers,
+                  "hidden_size": cfg.hidden_size, "num_heads": cfg.num_heads,
+                  "vocab_size": cfg.vocab_size},
+        "cuts": [int(c) for c in cuts],
+        "hop_codecs": [c if isinstance(c, str) else c.name for c in hop_codecs],
+        "max_length": int(max_length), "stride": int(stride),
+        "importance_method": importance_method,
+        "window_batch": int(window_batch), "n_seq": int(n_seq),
+        "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
+    }
+    rd = ResumableDriver(checkpoint_path, axes, checkpoint_every)
+    total_nll, n_tokens = 0.0, 0.0
     fwd_tokens = 0  # every token pushed through the pipeline (incl. overlap/pad)
+    real_fwd_tokens = 0  # same, minus batch-pad windows and seq-pad positions
     hop_bytes_total = [0] * len(rt.codecs)  # measured per chunk, tail included
+    if rd.state is not None:
+        total_nll, n_tokens = rd.state["total_nll"], rd.state["n_tokens"]
+        fwd_tokens = rd.state["fwd_tokens"]
+        real_fwd_tokens = rd.state["real_fwd_tokens"]
+        hop_bytes_total = list(rd.state["hop_bytes_total"])
+
+    def save_checkpoint():
+        rd.save({"total_nll": total_nll, "n_tokens": n_tokens,
+                 "fwd_tokens": fwd_tokens, "real_fwd_tokens": real_fwd_tokens,
+                 "hop_bytes_total": hop_bytes_total})
+
     bytes_cache: dict = {}
-    t0 = time.monotonic()
 
     def submit_group(group):
         n_real = len(group)
+        s_unpadded = group[0].input_ids.shape[1]
         counts = [c.num_loss_tokens for c in group]
         # pad a partial group up to the data-axis size with repeated windows;
         # their loss weight is zero
@@ -158,36 +194,48 @@ def run_split_eval(
         else:
             logits = rt.forward(placed, ids)
         nlls = nll_from_logits(logits, targets, per_example=True)
-        return group, n_real, counts, ids.shape, nlls
+        return group, n_real, s_unpadded, counts, ids.shape, nlls
 
     def drain_group(rec):
-        nonlocal total_nll, n_tokens, chunks, fwd_tokens
-        group, n_real, counts, (w, s_chunk), nlls = rec
-        total_nll += float(np.asarray(nlls, np.float64)
+        nonlocal total_nll, n_tokens, fwd_tokens, real_fwd_tokens
+        group, n_real, s_unpadded, counts, (w, s_chunk), nlls = rec
+        # the per-example NLLs ride the mesh's data axis, which is the one
+        # axis allowed to span processes in a multi-host run
+        total_nll += float(fetch_global(nlls).astype(np.float64)
                            @ np.asarray(counts, np.float64))
         n_tokens += sum(counts)
         fwd_tokens += w * s_chunk
+        real_fwd_tokens += n_real * s_unpadded
         key = (w, s_chunk)
         if key not in bytes_cache:  # payloads are shape-determined
             bytes_cache[key] = rt.hop_bytes(w, s_chunk)
         for i, b in enumerate(bytes_cache[key]):
             hop_bytes_total[i] += b
-        chunks += n_real
         if progress:
             progress(group[-1].index)
+        if rd.advance(group, count=n_real):
+            save_checkpoint()
+            _emit(metrics_path, {
+                "chunk": group[-1].index, "chunks": rd.chunks,
+                "n_tokens": n_tokens,
+                "ppl": float(np.exp(total_nll / max(n_tokens, 1e-9))),
+                "hop_bytes_total": hop_bytes_total})
 
     _run_pipelined(
         _iter_window_groups(token_ids, max_length, stride,
-                            window_batch=window_batch, max_count=max_chunks),
+                            window_batch=window_batch,
+                            start_chunk=rd.start_chunk,
+                            max_count=rd.remaining(max_chunks)),
         submit_group, drain_group)
-    wall = time.monotonic() - t0
+    wall = rd.wall()  # cumulative across resumes
+    save_checkpoint()
 
     seq = min(max_length, len(np.asarray(token_ids).reshape(-1)))
     result = {
         "ppl": float(np.exp(total_nll / max(n_tokens, 1e-9))),
         "total_nll": total_nll,
         "n_tokens": n_tokens,
-        "chunks": chunks,
+        "chunks": rd.chunks,
         "wall_s": wall,
         "tokens_per_s": fwd_tokens / max(wall, 1e-9),
         "scored_tokens_per_s": n_tokens / max(wall, 1e-9),
@@ -200,9 +248,19 @@ def run_split_eval(
         "measured_hop_bytes_total": hop_bytes_total,
         "measured_bytes_per_fwd_token_per_hop": [
             b / max(fwd_tokens, 1) for b in hop_bytes_total],
+        # fwd_tokens counts every pipeline-pushed token (batch-pad windows and
+        # seq-pad positions included — they DO cross the wire); these separate
+        # wire traffic from useful throughput for small corpora / big batches
+        "real_fwd_tokens": real_fwd_tokens,
+        "pad_fraction": 1.0 - real_fwd_tokens / max(fwd_tokens, 1),
+        "real_tokens_per_s": real_fwd_tokens / max(wall, 1e-9),
         "mesh": dict(mesh.shape),
     }
-    if time_hops and chunks:
+    if time_hops and rd.chunks:
         t_seq = seq if n_seq <= 1 else seq + (-seq) % n_seq
         result["per_hop_ms"] = rt.time_hops(1, t_seq)
+    _emit(metrics_path, {"final": True, "chunks": rd.chunks, "n_tokens": n_tokens,
+                         "ppl": result["ppl"], "wall_s": wall,
+                         "hop_bytes_total": hop_bytes_total,
+                         "pad_fraction": result["pad_fraction"]})
     return result

@@ -36,7 +36,6 @@ import jax.numpy as jnp
 
 from ..models.configs import ModelConfig
 from ..models.transformer import apply_rotary, embed, precompute_rope
-from ..eval.windowing import sliding_windows
 
 
 @jax.custom_vjp
@@ -188,20 +187,64 @@ def run_relevance_extraction(
     stride: int,
     max_chunks: Optional[int] = None,
     progress=None,
+    window_batch: int = 1,
+    checkpoint_path: Optional[str] = None,
+    checkpoint_every: int = 1000,
+    metrics_path: Optional[str] = None,
+    stats: Optional[dict] = None,
 ) -> np.ndarray:
     """Sliding-window accumulation of head relevance -> (L, H) weights,
     normalized per layer to sum 1 (``Relevance/main.py:74-118``). The output is
-    the ``head_weights`` input of ``weighted_importance``."""
+    the ``head_weights`` input of ``weighted_importance``.
+
+    Same durability and throughput treatment as the sweep drivers: up to
+    ``window_batch`` full-length windows share one vjp executable (relevance is
+    a plain sum over windows, so batching is exact — the seed is per-row and
+    ``_chunk_relevance`` already sums the batch axis); host accumulation is
+    pipelined one group behind device submission; an axes-validated checkpoint
+    gives exact resume, and chunk throughput (the reference anchor is 2.1 it/s,
+    ``BASELINE.md``) lands in ``stats`` (pass a dict) and ``metrics_path``.
+    """
+    from ..eval.harness import (ResumableDriver, _emit, _iter_window_groups,
+                                _run_pipelined)
+
     fn = _chunk_relevance(cfg)
-    total = np.zeros((cfg.num_layers, cfg.num_heads))
-    done = 0
-    for chunk in sliding_windows(token_ids, max_length, stride):
-        if max_chunks is not None and done >= max_chunks:
-            break
-        total += np.asarray(fn(params, jnp.asarray(chunk.input_ids)))
-        done += 1
+    axes = {"experiment": "relevance",
+            "model": {"family": cfg.family, "num_layers": cfg.num_layers,
+                      "hidden_size": cfg.hidden_size, "num_heads": cfg.num_heads,
+                      "vocab_size": cfg.vocab_size},
+            "max_length": int(max_length), "stride": int(stride)}
+    rd = ResumableDriver(checkpoint_path, axes, checkpoint_every)
+    total = (np.asarray(rd.state["total"]) if rd.state is not None
+             else np.zeros((cfg.num_layers, cfg.num_heads)))
+
+    def submit_group(group):
+        ids = jnp.asarray(np.concatenate([c.input_ids for c in group]))
+        return group, fn(params, ids)
+
+    def drain_group(rec):
+        group, dev = rec
+        total[...] += np.asarray(dev, np.float64)
         if progress:
-            progress(chunk.index)
+            progress(group[-1].index)
+        if rd.advance(group):
+            rd.save({"total": total.tolist()})
+            _emit(metrics_path, {"chunk": group[-1].index, "chunks": rd.chunks,
+                                 "it_per_s": rd.chunks / max(rd.wall(), 1e-9)})
+
+    _run_pipelined(
+        _iter_window_groups(token_ids, max_length, stride,
+                            window_batch=window_batch,
+                            start_chunk=rd.start_chunk,
+                            max_count=rd.remaining(max_chunks)),
+        submit_group, drain_group)
+    wall = rd.wall()  # cumulative across resumes
+    rd.save({"total": total.tolist()})
+    if stats is not None:
+        stats.update(chunks=rd.chunks, wall_s=wall,
+                     it_per_s=rd.chunks / max(wall, 1e-9))
+    _emit(metrics_path, {"final": True, "chunks": rd.chunks, "wall_s": wall,
+                         "it_per_s": rd.chunks / max(wall, 1e-9)})
     layer_sum = total.sum(axis=1, keepdims=True)
     denom = np.where(layer_sum != 0, layer_sum, 1e-9)
     return total / denom

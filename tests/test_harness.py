@@ -195,3 +195,32 @@ def test_initial_window_batching_is_exact(setup):
     batched = run_initial_sweep(CFG, params, corpus, window_batch=3, **kw)
     assert batched.chunks == single.chunks
     np.testing.assert_allclose(batched.total_nll, single.total_nll, rtol=1e-5, atol=1e-5)
+
+
+def test_run_with_oom_backoff():
+    """RESOURCE_EXHAUSTED halves the window batch until it fits; other errors
+    propagate untouched."""
+    from edgellm_tpu.eval.harness import run_with_oom_backoff
+
+    calls = []
+
+    def run(wb):
+        calls.append(wb)
+        if wb > 2:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating ...")
+        return "ok"
+
+    result, wb = run_with_oom_backoff(run, 8)
+    assert result == "ok" and wb == 2 and calls == [8, 4, 2]
+
+    def always_oom(wb):
+        raise RuntimeError("RESOURCE_EXHAUSTED")
+
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        run_with_oom_backoff(always_oom, 4)  # min batch reached -> re-raise
+
+    def other(wb):
+        raise ValueError("boom")
+
+    with pytest.raises(ValueError, match="boom"):
+        run_with_oom_backoff(other, 8)

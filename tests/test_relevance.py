@@ -229,3 +229,35 @@ def test_run_relevance_extraction_normalized(qwen_setup):
                                  max_chunks=3)
     assert w.shape == (cfg.num_layers, cfg.num_heads)
     np.testing.assert_allclose(w.sum(axis=1), 1.0, atol=1e-6)
+
+
+def test_window_batched_relevance_matches_unbatched(qwen_setup):
+    """Relevance is a plain sum over windows, so batching them is exact up to
+    fp32 in-batch summation order."""
+    cfg, params, _, _ = qwen_setup
+    corpus = np.random.default_rng(11).integers(0, 256, 150)
+    stats_b: dict = {}
+    want = run_relevance_extraction(cfg, params, corpus, max_length=32, stride=16)
+    got = run_relevance_extraction(cfg, params, corpus, max_length=32, stride=16,
+                                   window_batch=4, stats=stats_b)
+    np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+    assert stats_b["chunks"] > 0 and stats_b["it_per_s"] > 0
+
+
+def test_relevance_checkpoint_resume(qwen_setup, tmp_path):
+    cfg, params, _, _ = qwen_setup
+    corpus = np.random.default_rng(12).integers(0, 256, 150)
+    kw = dict(max_length=32, stride=16, window_batch=2)
+    want = run_relevance_extraction(cfg, params, corpus, **kw)
+
+    ckpt = str(tmp_path / "rel_ckpt.json")
+    metrics = str(tmp_path / "rel_metrics.jsonl")
+    run_relevance_extraction(cfg, params, corpus, max_chunks=4,
+                             checkpoint_path=ckpt, checkpoint_every=2,
+                             metrics_path=metrics, **kw)
+    got = run_relevance_extraction(cfg, params, corpus, checkpoint_path=ckpt,
+                                   checkpoint_every=2, metrics_path=metrics, **kw)
+    np.testing.assert_allclose(got, want, atol=1e-6, rtol=1e-6)
+    import json
+    lines = [json.loads(l) for l in open(metrics)]
+    assert lines[-1]["final"] and lines[-1]["it_per_s"] > 0

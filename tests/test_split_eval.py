@@ -112,6 +112,64 @@ def test_window_batch_not_multiple_of_data_axis_raises(setup):
                        mesh=make_stage_mesh(2, n_data=2), time_hops=False)
 
 
+def test_checkpoint_resume_exact(setup, tmp_path):
+    """Kill/resume: a run interrupted mid-corpus and resumed from its checkpoint
+    produces IDENTICAL final PPL, token counts, and measured byte totals."""
+    params, corpus = setup
+    kw = dict(cuts=[2], hop_codecs=["int8_per_token"], max_length=16, stride=8,
+              window_batch=2, time_hops=False)
+    want = run_split_eval(CFG, params, corpus, **kw)
+
+    ckpt = str(tmp_path / "split_ckpt.json")
+    metrics = str(tmp_path / "metrics.jsonl")
+    partial = run_split_eval(CFG, params, corpus, max_chunks=4,
+                             checkpoint_path=ckpt, checkpoint_every=2,
+                             metrics_path=metrics, **kw)
+    assert partial["chunks"] == 4
+    got = run_split_eval(CFG, params, corpus, checkpoint_path=ckpt,
+                         checkpoint_every=2, metrics_path=metrics, **kw)
+    assert got["chunks"] == want["chunks"]
+    assert got["n_tokens"] == want["n_tokens"]
+    assert got["measured_hop_bytes_total"] == want["measured_hop_bytes_total"]
+    assert got["real_fwd_tokens"] == want["real_fwd_tokens"]
+    np.testing.assert_allclose(got["ppl"], want["ppl"], rtol=1e-12)
+    import json as _json
+    lines = [_json.loads(l) for l in open(metrics)]
+    assert lines[-1]["final"] and lines[-1]["chunks"] == want["chunks"]
+
+
+def test_checkpoint_axes_mismatch_raises(setup, tmp_path):
+    params, corpus = setup
+    ckpt = str(tmp_path / "ckpt.json")
+    run_split_eval(CFG, params, corpus, cuts=[2], hop_codecs=["int8_per_token"],
+                   max_length=16, stride=8, max_chunks=2, checkpoint_path=ckpt,
+                   checkpoint_every=1, time_hops=False)
+    with pytest.raises(ValueError, match="different sweep configuration"):
+        run_split_eval(CFG, params, corpus, cuts=[2], hop_codecs=["int4_per_token"],
+                       max_length=16, stride=8, checkpoint_path=ckpt,
+                       time_hops=False)
+
+
+def test_pad_accounting_fields(setup):
+    """pad_fraction separates wire traffic from useful throughput: padded
+    windows (partial group under a data axis) and seq-pad positions are in
+    fwd_tokens but not real_fwd_tokens."""
+    from edgellm_tpu.parallel import make_stage_mesh
+
+    params, corpus = setup
+    # 13 windows at stride 8 -> last full group padded; n_seq=3 pads 16 -> 18
+    res = run_split_eval(CFG, params, corpus, cuts=[2],
+                         hop_codecs=["int8_per_token"], max_length=16, stride=8,
+                         n_seq=3, window_batch=2, time_hops=False)
+    assert 0.0 < res["pad_fraction"] < 1.0
+    assert res["real_tokens_per_s"] > 0
+
+    none = run_split_eval(CFG, params, corpus, cuts=[2],
+                          hop_codecs=["int8_per_token"], max_length=16, stride=8,
+                          time_hops=False)
+    assert none["pad_fraction"] == 0.0
+
+
 def test_ring_split_eval_matches_plain(setup):
     """n_seq > 1 (stage x seq ring runtime) reproduces the plain split eval,
     including a window length that needs right-padding to shard."""
