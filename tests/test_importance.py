@@ -99,3 +99,56 @@ def test_unknown_method_raises(stats):
         importance_per_layer(stats, "nope")
     with pytest.raises(ValueError):
         importance_per_layer(stats, "weighted_importance")  # missing head_weights
+
+
+class TestBlockedStatsCapture:
+    """The streaming (query-blocked) stats path vs the full-probs oracle
+    (stats_block=0 IS the old formulation): identical hidden outputs and
+    importance statistics without the (B, H, S, S) tensor."""
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        import jax
+        from edgellm_tpu.models import tiny_config, init_params
+
+        cfg = tiny_config("qwen2", num_layers=3, hidden_size=32, num_heads=4,
+                          vocab_size=64)
+        return cfg, init_params(cfg, jax.random.key(3))
+
+    @pytest.mark.parametrize("seq,blk", [(64, None), (64, 16), (20, None)])
+    def test_matches_full_probs_oracle(self, model, rng, seq, blk):
+        from edgellm_tpu.models import forward
+
+        cfg, params = model
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, seq)))
+        logits_full, aux_full = forward(cfg, params, ids, capture_stats=True,
+                                        stats_block=0)
+        logits_blk, aux_blk = forward(cfg, params, ids, capture_stats=True,
+                                      stats_block=blk)
+        np.testing.assert_allclose(np.asarray(logits_blk),
+                                   np.asarray(logits_full), atol=1e-5, rtol=1e-5)
+        for got, want in zip(aux_blk["stats"], aux_full["stats"]):
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-6, rtol=1e-5)
+        for method in ("regular_importance", "last_row", "aggregate_till"):
+            np.testing.assert_allclose(
+                np.asarray(importance_per_layer(aux_blk["stats"], method)),
+                np.asarray(importance_per_layer(aux_full["stats"], method)),
+                atol=1e-6, rtol=1e-5)
+
+    def test_bad_block_size_raises(self, model, rng):
+        from edgellm_tpu.models import forward
+
+        cfg, params = model
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, 64)))
+        with pytest.raises(ValueError, match="must divide"):
+            forward(cfg, params, ids, capture_stats=True, stats_block=24)
+
+    def test_auto_block_sizes(self):
+        from edgellm_tpu.models.transformer import _stats_block_size
+
+        assert _stats_block_size(512, None) == 128
+        assert _stats_block_size(64, None) == 32  # largest divisor < S
+        assert _stats_block_size(20, None) == 20  # no friendly divisor: 1 block
+        assert _stats_block_size(512, 0) == 512  # explicit oracle path
+        assert _stats_block_size(512, 64) == 64
