@@ -21,7 +21,11 @@ reductions, never per-token activation traffic.
 from .split import SplitConfig, SplitRuntime, make_stage_mesh
 from .ring import (ring_attention, forward_sp, make_seq_mesh,
                    SplitRingRuntime, make_sp_stage_mesh)
+from .distributed import (initialize_distributed, build_stage_grid,
+                          make_multihost_stage_mesh, make_multihost_sp_stage_mesh)
 
 __all__ = ["SplitConfig", "SplitRuntime", "make_stage_mesh",
            "ring_attention", "forward_sp", "make_seq_mesh",
-           "SplitRingRuntime", "make_sp_stage_mesh"]
+           "SplitRingRuntime", "make_sp_stage_mesh",
+           "initialize_distributed", "build_stage_grid",
+           "make_multihost_stage_mesh", "make_multihost_sp_stage_mesh"]

@@ -1,0 +1,125 @@
+"""Multi-host (multi-process / multi-slice) entry path.
+
+The reference scales past one machine with an MPI/NCCL-style backend (its
+device-migration helpers assume one process per GPU). The JAX equivalent is
+``jax.distributed.initialize()`` — after it, ``jax.devices()`` spans every
+host's chips and the SAME runtimes (``SplitRuntime``, ``SplitRingRuntime``)
+run unchanged over a global mesh; XLA routes each collective over ICI within
+a slice and DCN between slices.
+
+What this module adds over the plain mesh builders is the AXIS LAYOUT the
+package docstring (``parallel/__init__.py``) promises:
+
+- "stage" / "seq" / "model" axes are packed WITHIN a slice, so the per-cut
+  ``ppermute`` hops and the ring's K/V rotation ride ICI;
+- the embarrassingly-parallel "data" axis is the only axis that crosses
+  slices, so any DCN edge carries per-window NLL reductions, never per-token
+  activation traffic.
+
+``build_stage_grid`` is pure device-list bookkeeping (testable against mocked
+device objects — multi-process can't run in a single-host test environment);
+the ``make_multihost_*`` builders wrap the grid in a named ``Mesh``.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_initialized = False
+
+
+def initialize_distributed(coordinator_address: Optional[str] = None,
+                           num_processes: Optional[int] = None,
+                           process_id: Optional[int] = None) -> int:
+    """Join this process to the distributed runtime -> number of processes.
+
+    On TPU pods ``jax.distributed.initialize()`` auto-discovers everything
+    from the environment metadata; explicit args cover manual (e.g. GPU/CPU)
+    bring-up. Idempotent: repeated calls are no-ops.
+    """
+    global _initialized
+    if _initialized:
+        return jax.process_count()
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    return jax.process_count()
+
+
+def _slice_of(d) -> int:
+    """Slice index of a device: multi-slice TPUs expose ``slice_index``;
+    single-slice and CPU devices all land in slice 0 (treating each process
+    as its own 'slice' would forbid intra-slice multi-host stages, which ARE
+    ICI-connected on a real pod slice)."""
+    return getattr(d, "slice_index", 0) or 0
+
+
+def build_stage_grid(devices: Sequence, n_stages: int, n_data: Optional[int],
+                     n_model: int = 1, inner: str = "stage") -> np.ndarray:
+    """Arrange ``devices`` into an (n_stages, n_data, n_model) object grid such
+    that every (stage x model) group lives within ONE slice and the data axis
+    enumerates groups across slices.
+
+    ``n_data=None`` infers the data extent from the device count (every slice
+    must hold a whole number of groups). ``inner`` names the second axis only
+    for error messages ("stage" or "seq" — the ring layout is the same math).
+    """
+    group = n_stages * n_model
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(_slice_of(d), []).append(d)
+    for s in by_slice:
+        by_slice[s].sort(key=lambda d: (d.process_index, d.id))
+        if len(by_slice[s]) % group:
+            raise ValueError(
+                f"slice {s} holds {len(by_slice[s])} devices, not a multiple "
+                f"of the {inner} x model group size {group} — a group may not "
+                f"span slices (its hops must stay on ICI)")
+    total_groups = sum(len(v) // group for v in by_slice.values())
+    if n_data is None:
+        n_data = total_groups
+    if total_groups != n_data:
+        raise ValueError(f"device list yields {total_groups} ({inner} x model) "
+                         f"groups, but n_data={n_data} requested")
+    groups = []
+    for s in sorted(by_slice):
+        devs = by_slice[s]
+        for i in range(len(devs) // group):
+            flat = devs[i * group:(i + 1) * group]
+            groups.append(np.asarray(flat, object).reshape(n_stages, n_model))
+    # (n_data, n_stages, n_model) -> (n_stages, n_data, n_model)
+    return np.stack(groups, axis=0).transpose(1, 0, 2)
+
+
+def make_multihost_stage_mesh(n_stages: int, n_data: Optional[int] = None,
+                              n_model: int = 1, devices=None) -> Mesh:
+    """Slice-aware ("stage", "data", "model") mesh over every process's
+    devices. Drop-in for ``make_stage_mesh`` after
+    ``initialize_distributed()``; on one host the two agree."""
+    devices = list(devices) if devices is not None else jax.devices()
+    grid = build_stage_grid(devices, n_stages, n_data, n_model)
+    return Mesh(grid, ("stage", "data", "model"))
+
+
+def make_multihost_sp_stage_mesh(n_stages: int, n_seq: int,
+                                 devices=None) -> Mesh:
+    """Slice-aware ("stage", "seq") mesh for the composed stage x seq ring
+    runtime: each stage x seq group (whose hops and K/V rotation are the
+    per-token traffic) is pinned within a slice."""
+    devices = list(devices) if devices is not None else jax.devices()
+    grid = build_stage_grid(devices, n_stages, None, n_seq, inner="seq")
+    if grid.shape[1] != 1:
+        raise ValueError(
+            f"stage x seq mesh needs exactly n_stages*n_seq={n_stages * n_seq} "
+            f"devices, got {grid.shape[1]} groups; shrink the device list or "
+            f"run data-parallel ring groups as separate processes")
+    return Mesh(grid[:, 0, :], ("stage", "seq"))

@@ -118,12 +118,27 @@ def main(argv=None) -> int:
                          "DIR (view with TensorBoard/Perfetto; includes "
                          "ppermute hops and Pallas codec kernels)")
     ap.add_argument("--checkpoint-every", type=int, default=1000)
+    ap.add_argument("--distributed", action="store_true",
+                    help="join a multi-host run via jax.distributed.initialize() "
+                         "before touching devices; split meshes become "
+                         "slice-aware (stage/seq/model axes pinned within a "
+                         "slice, only the data axis crosses DCN)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--synthetic-corpus-len", type=int, default=4096)
     args = ap.parse_args(argv)
 
-    with open(args.params) as f:
-        params_json = json.load(f)
+    if args.distributed:
+        from .parallel import initialize_distributed
+
+        n_proc = initialize_distributed()
+        print(f"distributed: process {__import__('jax').process_index()} "
+              f"of {n_proc}", flush=True)
+
+    if args.params.lstrip().startswith("{"):  # inline JSON (REPRODUCING.md)
+        params_json = json.loads(args.params)
+    else:
+        with open(args.params) as f:
+            params_json = json.load(f)
 
     def load_head_weights():
         if not args.head_weights:
@@ -166,13 +181,20 @@ def main(argv=None) -> int:
             except ImportError as e:
                 raise SystemExit(f"relevance extraction unavailable: {e}") from e
 
+            stats: dict = {}
             weights = run_relevance_extraction(
                 cfg, params, corpus, max_length=max_length, stride=stride,
-                max_chunks=args.max_chunks)
+                max_chunks=args.max_chunks,
+                window_batch=max(args.window_batch, 1),
+                checkpoint_path=out("relevance_checkpoint.json"),
+                checkpoint_every=args.checkpoint_every,
+                metrics_path=out("relevance_metrics.jsonl"),
+                stats=stats)
             with open(out("attention_head_weights.json"), "w") as f:
                 json.dump(np.asarray(weights).tolist(), f)
             print(json.dumps({"artifact": out("attention_head_weights.json"),
-                              "shape": list(np.asarray(weights).shape)}))
+                              "shape": list(np.asarray(weights).shape),
+                              **stats}))
             return 0
 
         if experiment == "distances":
@@ -227,14 +249,28 @@ def main(argv=None) -> int:
             # (window_batch must be a multiple), "n_model" tensor-parallelizes
             # each stage; default is one device per pipeline stage
             mesh = None
+            n_stages = len(params_json["cuts"]) + 1
             if params_json.get("n_seq", 1) > 1 and (
                     params_json.get("n_data", 1) > 1
                     or params_json.get("n_model", 1) > 1):
                 raise SystemExit(
                     "n_seq composes the pipeline with sequence sharding only; "
                     "combining it with n_data/n_model is not supported")
-            if params_json.get("n_data", 1) > 1 or params_json.get("n_model", 1) > 1:
-                mesh = make_stage_mesh(len(params_json["cuts"]) + 1,
+            if args.distributed:
+                # slice-aware layout: stage/seq/model within a slice, data across
+                from .parallel import (make_multihost_sp_stage_mesh,
+                                       make_multihost_stage_mesh)
+
+                if params_json.get("n_seq", 1) > 1:
+                    mesh = make_multihost_sp_stage_mesh(
+                        n_stages, params_json["n_seq"])
+                else:
+                    mesh = make_multihost_stage_mesh(
+                        n_stages, n_data=params_json.get("n_data"),
+                        n_model=params_json.get("n_model", 1))
+            elif (params_json.get("n_data", 1) > 1
+                  or params_json.get("n_model", 1) > 1):
+                mesh = make_stage_mesh(n_stages,
                                        n_data=params_json.get("n_data", 1),
                                        n_model=params_json.get("n_model", 1))
             result = run_split_eval(
@@ -247,7 +283,10 @@ def main(argv=None) -> int:
                 max_chunks=args.max_chunks,
                 mesh=mesh,
                 window_batch=max(args.window_batch, 1),
-                n_seq=params_json.get("n_seq", 1))
+                n_seq=params_json.get("n_seq", 1),
+                checkpoint_path=out("split_checkpoint.json"),
+                checkpoint_every=args.checkpoint_every,
+                metrics_path=out("split_metrics.jsonl"))
             with open(out("split_eval_results.json"), "w") as f:
                 json.dump(result, f, indent=1)
             print(json.dumps(result))
