@@ -411,9 +411,21 @@ def _masked_ce(logits: jnp.ndarray, targets: jnp.ndarray,
     return jnp.sum(tok_nll, axis=axes) / jnp.maximum(jnp.sum(valid, axis=axes), 1)
 
 
+def _vocab_block_size(v: int, target: int = 8192) -> int:
+    """Largest divisor of ``v`` at most ``target`` via the smallest block
+    count; ``v`` itself when the vocab is small or has no useful divisor."""
+    if v <= 2 * target:
+        return v
+    for nb in range(2, 129):
+        if v % nb == 0 and v // nb <= target:
+            return v // nb
+    return v
+
+
 def nll_tail(cfg: ModelConfig, params: dict, hidden: jnp.ndarray,
              target_ids: jnp.ndarray, tail: int,
-             per_example: bool = False) -> jnp.ndarray:
+             per_example: bool = False,
+             vocab_block: Optional[int] = None) -> jnp.ndarray:
     """``nll_from_logits(unembed(cfg, params, hidden), target_ids)`` with the
     unembed restricted to the ``tail`` scoring positions.
 
@@ -425,11 +437,80 @@ def nll_tail(cfg: ModelConfig, params: dict, hidden: jnp.ndarray,
     ``[S - trg_len - 1, S - 2]``, so unembedding the last ``min(tail, S-1)``
     pre-final positions is exact whenever ``tail >= trg_len``. ``tail`` must be
     static (one executable per distinct tail length).
-    """
+
+    Large vocabularies stream: the head is processed in ``vocab_block``-column
+    blocks with an online logsumexp and in-block target-logit gather, so the
+    (rows, V) fp32 logits tensor — 9.6 GB for a ratio-vmapped 128-window
+    Qwen2 group — never materializes. Same FLOPs on the MXU, a fraction of
+    the HBM traffic. ``vocab_block=None`` auto-picks a divisor of V (~8k);
+    ``0`` forces the single-block path, which is exactly the old
+    full-logits formulation (the oracle in tests)."""
     s = hidden.shape[1]
     tail = min(int(tail), s - 1)
-    logits = unembed(cfg, params, hidden[:, s - 1 - tail: s - 1])
-    return _masked_ce(logits, target_ids[:, s - tail:], per_example)
+    h = hidden[:, s - 1 - tail: s - 1]
+    tgt = target_ids[:, s - tail:]
+    vb = (_vocab_block_size(cfg.vocab_size) if vocab_block is None
+          else (cfg.vocab_size if vocab_block == 0 else vocab_block))
+    if vb >= cfg.vocab_size:
+        return _masked_ce(unembed(cfg, params, h), tgt, per_example)
+    if cfg.vocab_size % vb:
+        raise ValueError(f"vocab_block {vb} must divide vocab {cfg.vocab_size}")
+    return _blocked_ce(cfg, params, h, tgt, per_example, vb)
+
+
+def _blocked_ce(cfg: ModelConfig, params: dict, hidden: jnp.ndarray,
+                targets: jnp.ndarray, per_example: bool, vb: int) -> jnp.ndarray:
+    """Streaming cross-entropy: final norm -> per-block partial logits ->
+    online (max, sumexp, target-logit) accumulation. The head tensor is
+    re-viewed blockwise in its OWN layout (no transpose copy of the 272 MB
+    embedding for tied heads)."""
+    b, t, d = hidden.shape
+    post = _norm(cfg, hidden, params["final_norm_scale"],
+                 params.get("final_norm_bias", 0.0)).reshape(b * t, d)
+    n = b * t
+    tgt = targets.reshape(n)
+    valid = tgt != -100
+    safe_tgt = jnp.where(valid, tgt, 0)
+    nb = cfg.vocab_size // vb
+    if cfg.tie_word_embeddings:
+        emb = params["embed"]  # (V, D): block rows, no transpose copy
+
+        def piece_of(i):
+            blk = jax.lax.dynamic_slice_in_dim(emb, i * vb, vb, axis=0)
+            return jnp.einsum("nd,vd->nv", post, blk,
+                              preferred_element_type=jnp.float32)
+    else:
+        head = params["lm_head"]  # (D, V): block columns in place
+
+        def piece_of(i):
+            blk = jax.lax.dynamic_slice_in_dim(head, i * vb, vb, axis=1)
+            return jnp.einsum("nd,dv->nv", post, blk,
+                              preferred_element_type=jnp.float32)
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),  # running max
+            jnp.zeros((n,), jnp.float32),           # running sum of exp
+            jnp.zeros((n,), jnp.float32))           # target logit
+
+    def body(carry, i):
+        m, s_acc, t_logit = carry
+        piece = piece_of(i)  # (N, vb) fp32, one block's logits
+        local_max = jnp.max(piece, axis=-1)
+        m_new = jnp.maximum(m, local_max)
+        s_acc = (s_acc * jnp.exp(m - m_new)
+                 + jnp.sum(jnp.exp(piece - m_new[:, None]), axis=-1))
+        local = safe_tgt - i * vb
+        in_blk = (local >= 0) & (local < vb)
+        val = jnp.take_along_axis(
+            piece, jnp.clip(local, 0, vb - 1)[:, None], axis=1)[:, 0]
+        t_logit = jnp.where(in_blk, val, t_logit)
+        return (m_new, s_acc, t_logit), None
+
+    (m, s_acc, t_logit), _ = jax.lax.scan(body, init, jnp.arange(nb))
+    tok_nll = jnp.where(valid, jnp.log(s_acc) + m - t_logit, 0.0)
+    tok_nll = tok_nll.reshape(b, t)
+    valid = valid.reshape(b, t)
+    axes = (1,) if per_example else None
+    return jnp.sum(tok_nll, axis=axes) / jnp.maximum(jnp.sum(valid, axis=axes), 1)
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32) -> dict:

@@ -120,3 +120,57 @@ def test_boundary_fn_interception(family_setup):
 
     same, _ = forward(cfg, params, jnp.asarray(ids), boundary_fn=noop)
     np.testing.assert_allclose(np.asarray(base), np.asarray(same), atol=1e-6)
+
+
+class TestBlockedTailCE:
+    """Vocab-blocked streaming CE vs the full-logits oracle (vocab_block=0):
+    identical NLLs without materializing the (rows, V) logits tensor."""
+
+    def _setup(self, family, tie):
+        import jax
+        from edgellm_tpu.models import tiny_config, init_params
+
+        cfg = tiny_config(family, num_layers=2, hidden_size=32, num_heads=4,
+                          vocab_size=128)
+        if cfg.tie_word_embeddings != tie:
+            cfg = cfg.__class__(**{**cfg.__dict__, "tie_word_embeddings": tie})
+        return cfg, init_params(cfg, jax.random.key(7))
+
+    @pytest.mark.parametrize("family,tie", [("qwen2", True), ("qwen2", False),
+                                            ("gpt_neox", False)])
+    @pytest.mark.parametrize("vb", [32, 64])
+    def test_matches_full_logits(self, rng, family, tie, vb):
+        import jax.numpy as jnp
+        from edgellm_tpu.models.transformer import nll_tail
+
+        cfg, params = self._setup(family, tie)
+        hidden = jnp.asarray(rng.normal(size=(3, 16, 32)).astype(np.float32))
+        targets = np.asarray(rng.integers(0, 128, (3, 16)))
+        targets[:, :10] = -100  # windowing mask
+        targets[2, :] = -100  # one fully-masked row
+        targets = jnp.asarray(targets)
+        for per_example in (False, True):
+            want = nll_tail(cfg, params, hidden, targets, tail=7,
+                            per_example=per_example, vocab_block=0)
+            got = nll_tail(cfg, params, hidden, targets, tail=7,
+                           per_example=per_example, vocab_block=vb)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_auto_blocks_only_large_vocabs(self):
+        from edgellm_tpu.models.transformer import _vocab_block_size
+
+        assert _vocab_block_size(128) == 128  # tiny: single block (old path)
+        assert _vocab_block_size(151936) == 4748  # Qwen2: 32 blocks
+        assert 151936 % _vocab_block_size(151936) == 0
+        assert _vocab_block_size(50304) == 6288  # Pythia: 8 blocks
+        assert _vocab_block_size(32000) == 8000  # Llama-2-ish
+
+    def test_bad_block_raises(self, rng):
+        import jax.numpy as jnp
+        from edgellm_tpu.models.transformer import nll_tail
+
+        cfg, params = self._setup("qwen2", True)
+        with pytest.raises(ValueError, match="divide"):
+            nll_tail(cfg, params, jnp.zeros((1, 8, 32)), jnp.zeros((1, 8), int),
+                     tail=3, vocab_block=33)
