@@ -23,7 +23,16 @@ achievable bf16 matmul ceiling and report mfu_vs_measured), BENCH_PALLAS
 (default 1 on TPU: append the on-silicon Pallas codec parity+throughput
 block), BENCH_RELEVANCE (default 1 on TPU: append LRP head-relevance
 extraction throughput, reference anchor 2.1 it/s), BENCH_REL_CHUNKS
-(default 24).
+(default 24), BENCH_REL_WINDOW_BATCH (requested relevance batch, preflighted
+down to fit, default 16), BENCH_HBM_GB (device memory for the window-batch
+preflight, default 15.75).
+
+An over-large BENCH_WINDOW_BATCH never kills the bench: on TPU an AOT
+memory-analysis preflight (tools/wb_preflight.py) halves it to the largest
+batch whose estimated peak fits BEFORE anything runs (a real TPU OOM would
+poison the process allocator); on other backends the warmup halves in-process
+on RESOURCE_EXHAUSTED. The bench line reports both the requested and
+effective batch.
 """
 import json
 import os
@@ -66,17 +75,37 @@ def main():
         codec=codec,
     )
 
-    from edgellm_tpu.eval.harness import run_with_oom_backoff
+    from edgellm_tpu.eval.harness import DEDUP_ZERO_CODECS, run_with_oom_backoff
 
-    # warmup: one full untimed pass over the same chunk schedule, so every
-    # executable the timed run needs (chunk-0 group, steady groups, the final
-    # partial group) is compiled and cached before the clock starts. An OOM at
-    # the requested window batch halves it instead of dying (and the timed run
-    # then uses the surviving batch from the start).
-    _, window_batch = run_with_oom_backoff(
-        lambda wb: run_token_sweep(cfg, params, corpus, max_chunks=n_chunks,
-                                   window_batch=wb, **kw),
-        window_batch)
+    # the executable run_token_sweep actually builds vmaps only the NONZERO
+    # ratios when the codec's fp baseline is deduped — size the preflight for
+    # the same ratio axis it will compile
+    n_sweep_ratios = (sum(1 for r in ratios if float(r) != 0.0)
+                      if codec in DEDUP_ZERO_CODECS else len(ratios))
+    requested_wb = window_batch
+    if jax.default_backend() == "tpu":
+        # pick the largest window batch that FITS before touching device
+        # memory: a real TPU OOM poisons the process allocator, so the
+        # preflight AOT-compiles the sweep executables and reads XLA's memory
+        # analysis (no allocation) instead of trying-and-backing-off
+        from edgellm_tpu.tools.wb_preflight import largest_fitting_window_batch
+
+        window_batch, _ = largest_fitting_window_batch(
+            cfg, window_batch, max_length=max_length, tail=stride + 1,
+            layer=layers_of_interest[0], codec=codec,
+            n_ratios=n_sweep_ratios, dtype=dtype)
+        # warmup: one full untimed pass over the same chunk schedule, so every
+        # executable the timed run needs (chunk-0 group, steady groups, the
+        # final partial group) is compiled and cached before the clock starts
+        run_token_sweep(cfg, params, corpus, max_chunks=n_chunks,
+                        window_batch=window_batch, **kw)
+    else:
+        # non-TPU backends recover from OOM in-process: warmup under the
+        # halving backoff, then time at the surviving batch
+        _, window_batch = run_with_oom_backoff(
+            lambda wb: run_token_sweep(cfg, params, corpus, max_chunks=n_chunks,
+                                       window_batch=wb, **kw),
+            window_batch)
 
     t0 = time.monotonic()
     result = run_token_sweep(cfg, params, corpus, max_chunks=n_chunks,
@@ -87,8 +116,6 @@ def main():
     # analytic FLOPs for a steady-state chunk (stride-token scoring tail);
     # counts executed work only (the fp-baseline column is deduped across
     # methods by the harness exactly when the codec is in DEDUP_ZERO_CODECS)
-    from edgellm_tpu.eval.harness import DEDUP_ZERO_CODECS
-
     n_zero = (sum(1 for r in ratios if float(r) == 0.0)
               if codec in DEDUP_ZERO_CODECS else 0)
     chunk_flops = token_sweep_flops_per_chunk(
@@ -104,6 +131,7 @@ def main():
         "vs_baseline": round(REFERENCE_S_PER_CHUNK / s_per_chunk, 2),
         "tokens_per_s": round(stride / s_per_chunk, 1),
         "window_batch": window_batch,
+        "requested_window_batch": requested_wb,
         "model_tflops_per_chunk": round(chunk_flops / 1e12, 3),
         "model_tflops_per_s": round(tflops_per_s, 2),
         "mfu": round(tflops_per_s / peak_tflops, 4),
@@ -118,23 +146,29 @@ def main():
         from edgellm_tpu.utils.profiling import measure_peak_tflops
 
         measured = measure_peak_tflops()
-        line["measured_peak_tflops"] = round(measured, 1)
-        line["mfu_vs_measured"] = round(tflops_per_s / measured, 4)
+        if measured is not None:  # None = noise swallowed every differential
+            line["measured_peak_tflops"] = round(measured, 1)
+            line["mfu_vs_measured"] = round(tflops_per_s / measured, 4)
 
     # LRP head-relevance extraction throughput (reference: 2.1 it/s on its
     # GPU for the same Qwen2-0.5B/512-token workload, BASELINE.md)
     if on_tpu and os.environ.get("BENCH_RELEVANCE", "1") != "0":
         from edgellm_tpu.importance.relevance import run_relevance_extraction
 
+        from edgellm_tpu.tools.wb_preflight import largest_fitting_relevance_batch
+
         rel_chunks = int(os.environ.get("BENCH_REL_CHUNKS", "24"))
         rel_kw = dict(max_length=max_length, stride=stride, max_chunks=rel_chunks)
-        _, rel_wb = run_with_oom_backoff(  # warmup, OOM-safe
-            lambda wb: run_relevance_extraction(cfg, params, corpus,
-                                                window_batch=wb, **rel_kw), 4)
+        rel_wb = largest_fitting_relevance_batch(
+            cfg, int(os.environ.get("BENCH_REL_WINDOW_BATCH", "16")),
+            max_length=max_length, dtype=dtype)
+        run_relevance_extraction(cfg, params, corpus, window_batch=rel_wb,
+                                 **rel_kw)  # warmup
         rel_stats: dict = {}
         run_relevance_extraction(cfg, params, corpus, window_batch=rel_wb,
                                  stats=rel_stats, **rel_kw)
         line["relevance_it_per_s"] = round(rel_stats["it_per_s"], 2)
+        line["relevance_window_batch"] = rel_wb
         line["relevance_vs_baseline"] = round(rel_stats["it_per_s"] / 2.1, 2)
 
     # on-silicon proof of the Pallas codec substitution path (VERDICT r2 #1):

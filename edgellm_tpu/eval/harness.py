@@ -64,16 +64,26 @@ def run_with_oom_backoff(run: Callable[[int], object], window_batch: int,
     ``run`` must be restartable (the sweep drivers are: each call builds fresh
     accumulators, and with a ``checkpoint_path`` a retried call resumes exactly
     from the last checkpoint, so work done before the OOM is kept)."""
+    import gc
+
     wb = window_batch
     while True:
+        msg = None
         try:
             return run(wb), wb
         except Exception as e:  # XlaRuntimeError isn't a stable public type
             if not is_oom_error(e) or wb <= min_window_batch:
                 raise
+            msg = str(e)
             wb = max(wb // 2, min_window_batch)
-            if on_backoff:
-                on_backoff(wb, e)
+        # cleanup OUTSIDE the except block: while the handler is active the
+        # interpreter's exception state still references the traceback frames
+        # (which pin the failed launch's device buffers), so a collect inside
+        # it could not free them
+        gc.collect()
+        jax.clear_caches()
+        if on_backoff:
+            on_backoff(wb, msg)
 
 
 def _apply_token_codec(codec: str, hidden, importance, ratio, k):

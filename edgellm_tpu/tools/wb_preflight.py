@@ -1,0 +1,146 @@
+"""AOT window-batch preflight: pick the largest batch that FITS, never OOM.
+
+On the tunneled TPU backend a real RESOURCE_EXHAUSTED poisons the process's
+device allocator — after one failed launch even a tiny ``device_put`` fails,
+so recover-by-retry (``run_with_oom_backoff``) cannot help. The robust order
+is reversed: AOT-compile the sweep's two big executables (the stats forward
+and the ratio-vmapped suffix sweep) at each candidate batch and read XLA's
+``memory_analysis()`` — compilation allocates no HBM — then run only the
+batch whose estimated peak fits.
+
+The estimate for one executable is ``argument + output + temp`` bytes; on top
+of the worst call the sweep keeps TWO boundary-hidden stacks alive (the
+drained group's and the in-flight next group's, from the submit/drain
+double-buffering) plus the captured stats, which are added analytically.
+``budget_frac`` absorbs what the estimate cannot see (allocator slack,
+fragmentation, the small executables).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+DEFAULT_HBM_BYTES = int(15.75 * 2 ** 30)  # TPU v5e; override with BENCH_HBM_GB
+
+
+def _is_over_hbm(e: BaseException) -> bool:
+    """True when a compile failed because the program provably exceeds HBM
+    ('Program hbm requirement ...G' dump) — extends the runtime-OOM vocabulary
+    of :func:`edgellm_tpu.eval.harness.is_oom_error` to compile time."""
+    from ..eval.harness import is_oom_error
+
+    msg = str(e)
+    return ("hbm requirement" in msg or "allocations in hbm" in msg
+            or is_oom_error(e))
+
+
+def _budget_bytes(hbm_bytes: Optional[int], budget_frac: float) -> int:
+    if hbm_bytes is None:
+        hbm_bytes = int(float(os.environ.get("BENCH_HBM_GB", "0")) * 2 ** 30) \
+            or DEFAULT_HBM_BYTES
+    return int(hbm_bytes * budget_frac)
+
+
+def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
+                              tail: int, layer: int, codec: str,
+                              n_ratios: int, dtype) -> dict:
+    """Estimated HBM peak of the token sweep at one window batch (bytes)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..eval.harness import _stats_forward, _suffix_sweep
+    from ..models import init_params
+
+    W, S, L, D = window_batch, max_length, cfg.num_layers, cfg.hidden_size
+    params_shape = jax.eval_shape(
+        lambda k: init_params(cfg, k, dtype=dtype), jax.random.key(0))
+    ids = jax.ShapeDtypeStruct((W, S), jnp.int32)
+
+    def call_bytes(lowered) -> Optional[int]:
+        """argument+output+temp bytes, or None when the TPU compiler itself
+        rejects the program as over-HBM — a provable doesn't-fit, still with
+        zero allocation."""
+        try:
+            compiled = lowered.compile()
+        except Exception as e:
+            if _is_over_hbm(e):
+                return None
+            raise
+        ma = compiled.memory_analysis()
+        return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
+                   + ma.temp_size_in_bytes)
+
+    stats = call_bytes(_stats_forward(cfg).lower(params_shape, ids))
+
+    hidden = jax.ShapeDtypeStruct((W, S, D), dtype)
+    targets = jax.ShapeDtypeStruct((W, S), jnp.int32)
+    imp = jax.ShapeDtypeStruct((W, S), jnp.float32)
+    ratios = jax.ShapeDtypeStruct((n_ratios,), jnp.float32)
+    ks = jax.ShapeDtypeStruct((n_ratios,), jnp.int32)
+    suffix = call_bytes(_suffix_sweep(cfg, layer, codec, tail)
+                        .lower(params_shape, hidden, targets, imp, ratios, ks))
+
+    if stats is None or suffix is None:  # compiler-proven over-HBM
+        return {"stats_call": stats, "suffix_call": suffix,
+                "hiddens_stack": 0, "peak": float("inf")}
+    itemsize = jnp.dtype(dtype).itemsize
+    hiddens_stack = L * W * S * D * itemsize  # collect_hidden output, per group
+    stats_buf = 2 * L * W * cfg.num_heads * S * 4  # col_mean + last_row, fp32
+    # worst single call + the other live group state the call's args don't hold:
+    # the suffix sees one (W,S,D) slice as an arg while BOTH groups' full
+    # stacks are alive (submit/drain double buffering)
+    peak = max(stats + hiddens_stack,  # stats call + previous group's stack
+               suffix + 2 * hiddens_stack + 2 * stats_buf)
+    return {"stats_call": stats, "suffix_call": suffix,
+            "hiddens_stack": hiddens_stack, "peak": peak}
+
+
+def largest_fitting_relevance_batch(cfg, requested: int, *, max_length: int,
+                                    dtype, hbm_bytes: Optional[int] = None,
+                                    budget_frac: float = 0.8,
+                                    min_window_batch: int = 1) -> int:
+    """Largest window batch whose LRP vjp executable fits — same AOT
+    memory-analysis approach as the sweep preflight (the (L, W, H, S, S)
+    probs + their cotangents dominate)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..importance.relevance import _chunk_relevance
+    from ..models import init_params
+
+    budget = _budget_bytes(hbm_bytes, budget_frac)
+    params_shape = jax.eval_shape(
+        lambda k: init_params(cfg, k, dtype=dtype), jax.random.key(0))
+    wb = requested
+    while wb > min_window_batch:
+        ids = jax.ShapeDtypeStruct((wb, max_length), jnp.int32)
+        try:
+            compiled = _chunk_relevance(cfg).lower(params_shape, ids).compile()
+        except Exception as e:
+            if _is_over_hbm(e):
+                wb = max(wb // 2, min_window_batch)
+                continue
+            raise
+        ma = compiled.memory_analysis()
+        if (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes) <= budget:
+            return wb
+        wb = max(wb // 2, min_window_batch)
+    return wb
+
+
+def largest_fitting_window_batch(cfg, requested: int, *, max_length: int,
+                                 tail: int, layer: int, codec: str,
+                                 n_ratios: int, dtype,
+                                 hbm_bytes: Optional[int] = None,
+                                 budget_frac: float = 0.8,
+                                 min_window_batch: int = 1) -> tuple:
+    """Halve ``requested`` until the estimated peak fits -> (wb, estimate)."""
+    budget = _budget_bytes(hbm_bytes, budget_frac)
+    wb = requested
+    while True:
+        est = estimate_sweep_peak_bytes(cfg, wb, max_length, tail, layer,
+                                        codec, n_ratios, dtype)
+        if est["peak"] <= budget or wb <= min_window_batch:
+            return wb, est
+        wb = max(wb // 2, min_window_batch)
