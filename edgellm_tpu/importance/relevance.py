@@ -134,8 +134,13 @@ def lrp_forward(cfg: ModelConfig, params: dict, input_ids, probs_offsets):
     One ``lax.scan`` over the stacked layers, rematerialized per layer
     (``jax.checkpoint``) so the backward pass recomputes activations instead of
     storing them — the reference's ``gradient_checkpointing_enable``.
+
+    The residual stream is pinned to fp32 regardless of the param dtype: the
+    LRP norm rules already emit fp32 (their rsqrt is stop-gradiented in fp32),
+    so a bf16 param pytree would otherwise flip the scan carry's dtype
+    mid-layer; the reference's relevance run is fp32 torch throughout.
     """
-    hidden = embed(params, input_ids)
+    hidden = embed(params, input_ids).astype(jnp.float32)
     cos, sin = precompute_rope(cfg, input_ids.shape[1])
 
     @jax.checkpoint

@@ -10,13 +10,23 @@ CPU mesh the tests use.
 
 Multi-host scaling: every runtime here is written against ``jax.devices()``
 and a named ``Mesh``, so the same code runs across hosts once
-``jax.distributed.initialize()`` has joined them — ``jax.devices()`` then
-spans the full slice/pod and the mesh builders lay stages/seq shards over it.
+``jax.distributed.initialize()`` has joined them (``run.py --distributed`` /
+:func:`initialize_distributed`) — ``jax.devices()`` then spans the full
+slice/pod and the slice-aware builders (:func:`make_multihost_stage_mesh`,
+:func:`make_multihost_sp_stage_mesh`) lay stages/seq shards over it.
 Axis layout determines the fabric each collective rides: keep the "stage" and
 "seq" axes within a slice so the per-cut ``ppermute`` and the ring's K/V
 rotation stay on ICI, and put the embarrassingly-parallel "data" axis
 outermost so any cross-slice (DCN) edge only carries the per-window NLL
 reductions, never per-token activation traffic.
+
+Compile-time scaling of the static unrolls (the pipeline protocol unrolls its
+stages, the ring unrolls its n_seq hops): measured first-call time
+(trace+compile, tiny shapes, CPU) grows LINEARLY — ~0.3 s/stage and
+~0.3 s/hop out to 32 of either, with no cliff. The composed stage x seq
+runtime multiplies the two (O(stages * n_seq) unrolled hops), so a
+4-stage x 8-seq pod layout compiles in the same ballpark as 32 plain stages;
+at the BASELINE configs' 2-3 stages compile cost is negligible.
 """
 from .split import SplitConfig, SplitRuntime, make_stage_mesh
 from .ring import (ring_attention, forward_sp, make_seq_mesh,

@@ -133,8 +133,11 @@ def _timed_scan(build_body, pool_tree, pool: int, lengths=None) -> float:
                                                            keepdims=False), tree)
                 out = build_body(x)
                 leaves = jax.tree_util.tree_leaves(out)
-                acc = sum(l.reshape(-1)[0].astype(jnp.float32)
-                          for l in leaves if l.size)
+                # FULL reduction over every leaf: a single-element read would
+                # let XLA's slice-pushdown shrink the body (dot(a,b)[0,0]
+                # becomes a vector dot and times as a no-op). The reduce fuses
+                # into the producer, so it adds no extra HBM round trip.
+                acc = sum(jnp.sum(l.astype(jnp.float32)) for l in leaves if l.size)
                 return carry + acc, None
 
             carry, _ = jax.lax.scan(body, jnp.float32(0.0),

@@ -261,3 +261,20 @@ def test_relevance_checkpoint_resume(qwen_setup, tmp_path):
     import json
     lines = [json.loads(l) for l in open(metrics)]
     assert lines[-1]["final"] and lines[-1]["it_per_s"] > 0
+
+
+def test_relevance_with_bf16_params(qwen_setup):
+    """The bench runs relevance on a bf16 param pytree; the fp32-pinned LRP
+    stream must accept it without the scan-carry dtype mismatch that bf16
+    params once triggered. No closeness-to-fp32 claim: the vjp seed selects
+    the ARGMAX last-position logit, which can flip token under bf16-rounded
+    weights — relevance is discontinuous in the weights by construction."""
+    cfg, params, _, _ = qwen_setup
+    corpus = np.random.default_rng(13).integers(0, 256, 100)
+    bf16 = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
+    wbf = run_relevance_extraction(cfg, bf16, corpus, max_length=32, stride=16,
+                                   window_batch=2)
+    assert np.isfinite(wbf).all()
+    np.testing.assert_allclose(wbf.sum(axis=1), 1.0, atol=1e-6)
