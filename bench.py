@@ -14,7 +14,9 @@ vs_baseline > 1 means faster than the reference's s/chunk on its hardware,
 plus observability fields: tokens_per_s (scored tokens), model_tflops_per_s and
 mfu (analytic sweep FLOPs vs the chip's assumed bf16 peak).
 
-Env knobs: BENCH_CHUNKS (default 96), BENCH_WINDOW_BATCH (default 64 — batches
+Env knobs: BENCH_MODEL (any model preset, default qwen2-0.5b — the
+vs_baseline ratio is only meaningful against the reference's Qwen2-0.5B
+anchor), BENCH_CHUNKS (default 96), BENCH_WINDOW_BATCH (default 64 — batches
 evaluation windows into one executable to feed the MXU; OOM backs off by
 halving instead of dying), BENCH_DTYPE (float32|bfloat16, default bfloat16),
 BENCH_PEAK_TFLOPS (assumed bf16 peak for the MFU denominator, default 197 =
@@ -46,10 +48,15 @@ REFERENCE_S_PER_CHUNK = 16.0  # qwen2-0.5B_experiment.ipynb cell 12 (BASELINE.md
 def main():
     import jax
     import jax.numpy as jnp
-    from edgellm_tpu.models import QWEN2_0_5B as cfg, init_params
+    from edgellm_tpu.models import PRESETS, init_params
     from edgellm_tpu.eval import run_token_sweep
     from edgellm_tpu.utils.flops import token_sweep_flops_per_chunk
 
+    # BENCH_MODEL switches the swept model (e.g. qwen2-1.5b); the reference's
+    # 16 s/chunk anchor is its Qwen2-0.5B run, so vs_baseline is only emitted
+    # for the default model
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
     n_chunks = int(os.environ.get("BENCH_CHUNKS", "96"))
     window_batch = int(os.environ.get("BENCH_WINDOW_BATCH", "64"))
     peak_tflops = float(os.environ.get("BENCH_PEAK_TFLOPS", "197"))
@@ -58,7 +65,9 @@ def main():
 
     max_length, stride = 512, 32
     methods = ["regular_importance", "weighted_importance", "last_row", "aggregate_till"]
-    layers_of_interest = [11]
+    # the reference's headline split layer (11) where it exists; mid-stack for
+    # shallower presets so any BENCH_MODEL runs
+    layers_of_interest = [min(11, cfg.num_layers // 2)]
     ratios = [0.0, 0.25, 0.5, 0.75, 1.0]
 
     params = init_params(cfg, jax.random.key(0), dtype=dtype)
@@ -75,25 +84,20 @@ def main():
         codec=codec,
     )
 
-    from edgellm_tpu.eval.harness import DEDUP_ZERO_CODECS, run_with_oom_backoff
+    from edgellm_tpu.eval.harness import run_with_oom_backoff
 
-    # the executable run_token_sweep actually builds vmaps only the NONZERO
-    # ratios when the codec's fp baseline is deduped — size the preflight for
-    # the same ratio axis it will compile
-    n_sweep_ratios = (sum(1 for r in ratios if float(r) != 0.0)
-                      if codec in DEDUP_ZERO_CODECS else len(ratios))
     requested_wb = window_batch
     if jax.default_backend() == "tpu":
         # pick the largest window batch that FITS before touching device
         # memory: a real TPU OOM poisons the process allocator, so the
         # preflight AOT-compiles the sweep executables and reads XLA's memory
         # analysis (no allocation) instead of trying-and-backing-off
-        from edgellm_tpu.tools.wb_preflight import largest_fitting_window_batch
+        from edgellm_tpu.tools.wb_preflight import preflight_token_sweep_batch
 
-        window_batch, _ = largest_fitting_window_batch(
-            cfg, window_batch, max_length=max_length, tail=stride + 1,
-            layer=layers_of_interest[0], codec=codec,
-            n_ratios=n_sweep_ratios, dtype=dtype)
+        window_batch = preflight_token_sweep_batch(
+            cfg, window_batch, max_length=max_length, stride=stride,
+            layers_of_interest=layers_of_interest, ratios=ratios,
+            dtype=dtype, codec=codec)
         # warmup: one full untimed pass over the same chunk schedule, so every
         # executable the timed run needs (chunk-0 group, steady groups, the
         # final partial group) is compiled and cached before the clock starts
@@ -125,10 +129,11 @@ def main():
     tflops_per_s = chunk_flops / s_per_chunk / 1e12
 
     line = {
-        "metric": "qwen2-0.5b sweep time per 32-token chunk (4 methods x 1 layer x 5 ratios)",
+        "metric": f"{model_name} sweep time per 32-token chunk (4 methods x 1 layer x 5 ratios)",
         "value": round(s_per_chunk, 4),
         "unit": "s/chunk",
-        "vs_baseline": round(REFERENCE_S_PER_CHUNK / s_per_chunk, 2),
+        "vs_baseline": (round(REFERENCE_S_PER_CHUNK / s_per_chunk, 2)
+                        if model_name == "qwen2-0.5b" else None),
         "tokens_per_s": round(stride / s_per_chunk, 1),
         "window_batch": window_batch,
         "requested_window_batch": requested_wb,
@@ -169,7 +174,8 @@ def main():
                                  stats=rel_stats, **rel_kw)
         line["relevance_it_per_s"] = round(rel_stats["it_per_s"], 2)
         line["relevance_window_batch"] = rel_wb
-        line["relevance_vs_baseline"] = round(rel_stats["it_per_s"] / 2.1, 2)
+        if model_name == "qwen2-0.5b":  # the 2.1 it/s anchor is this workload
+            line["relevance_vs_baseline"] = round(rel_stats["it_per_s"] / 2.1, 2)
 
     # on-silicon proof of the Pallas codec substitution path (VERDICT r2 #1):
     # every *_pallas wire codec executed on the real backend, parity + GB/s

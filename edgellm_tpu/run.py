@@ -305,6 +305,25 @@ def main(argv=None) -> int:
             if head_weights is None and "weighted_importance" in methods:
                 raise SystemExit("weighted_importance requires --head-weights "
                                  "(produce it with experiment: \"relevance\")")
+            import jax
+
+            if jax.default_backend() == "tpu" and common["window_batch"] > 1:
+                # a real TPU OOM poisons the process allocator; pre-shrink the
+                # window batch by AOT memory analysis (no allocation) so big
+                # real-corpus runs degrade instead of dying (bench.py does the
+                # same)
+                from .tools.wb_preflight import preflight_token_sweep_batch
+
+                wb = preflight_token_sweep_batch(
+                    cfg, common["window_batch"], max_length=max_length,
+                    stride=stride,
+                    layers_of_interest=params_json["layers_of_interest"],
+                    ratios=params_json["ratios"],
+                    dtype=next(iter(jax.tree_util.tree_leaves(params))).dtype)
+                if wb != common["window_batch"]:
+                    print(f"window_batch {common['window_batch']} exceeds the "
+                          f"memory budget; running at {wb}", flush=True)
+                    common["window_batch"] = wb
             result = run_token_sweep(
                 cfg, params, corpus, methods=methods or ["regular_importance"],
                 layers_of_interest=params_json["layers_of_interest"],

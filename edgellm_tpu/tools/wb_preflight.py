@@ -95,6 +95,28 @@ def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
             "hiddens_stack": hiddens_stack, "peak": peak}
 
 
+def preflight_token_sweep_batch(cfg, requested: int, *, max_length: int,
+                                stride: int, layers_of_interest: Sequence[int],
+                                ratios: Sequence[float], dtype,
+                                codec: str = "int4_token_select",
+                                hbm_bytes: Optional[int] = None,
+                                budget_frac: float = 0.8) -> int:
+    """Sweep-shaped wrapper around :func:`largest_fitting_window_batch`,
+    shared by bench.py and run.py: sizes the EARLIEST split layer (longest
+    suffix = biggest executable) and counts the ratio axis the way
+    run_token_sweep compiles it (nonzero ratios only for dedup codecs)."""
+    from ..eval.harness import DEDUP_ZERO_CODECS
+
+    n_ratios = (sum(1 for r in ratios if float(r) != 0.0)
+                if codec in DEDUP_ZERO_CODECS else len(ratios))
+    wb, _ = largest_fitting_window_batch(
+        cfg, requested, max_length=max_length, tail=stride + 1,
+        layer=min(int(l) for l in layers_of_interest), codec=codec,
+        n_ratios=max(n_ratios, 1), dtype=dtype,
+        hbm_bytes=hbm_bytes, budget_frac=budget_frac)
+    return wb
+
+
 def largest_fitting_relevance_batch(cfg, requested: int, *, max_length: int,
                                     dtype, hbm_bytes: Optional[int] = None,
                                     budget_frac: float = 0.8,

@@ -49,3 +49,20 @@ def test_relevance_preflight_halves_to_fit():
                                            dtype=jnp.float32,
                                            hbm_bytes=1, budget_frac=1.0)
     assert tiny == 1
+
+
+def test_token_sweep_preflight_uses_earliest_layer():
+    """The shared sweep wrapper sizes the longest suffix (earliest layer) and
+    the dedup-aware ratio axis; a generous budget keeps the requested batch."""
+    from edgellm_tpu.tools.wb_preflight import preflight_token_sweep_batch
+
+    wb = preflight_token_sweep_batch(CFG, 4, max_length=32, stride=8,
+                                     layers_of_interest=[2, 1], ratios=[0, 0.5],
+                                     dtype=jnp.float32, hbm_bytes=1 << 40,
+                                     budget_frac=1.0)
+    assert wb == 4
+    tiny = preflight_token_sweep_batch(CFG, 4, max_length=32, stride=8,
+                                       layers_of_interest=[2, 1],
+                                       ratios=[0, 0.5], dtype=jnp.float32,
+                                       hbm_bytes=1, budget_frac=1.0)
+    assert tiny == 1
