@@ -120,6 +120,8 @@ def main():
     # analytic FLOPs for a steady-state chunk (stride-token scoring tail);
     # counts executed work only (the fp-baseline column is deduped across
     # methods by the harness exactly when the codec is in DEDUP_ZERO_CODECS)
+    from edgellm_tpu.eval.harness import DEDUP_ZERO_CODECS
+
     n_zero = (sum(1 for r in ratios if float(r) == 0.0)
               if codec in DEDUP_ZERO_CODECS else 0)
     chunk_flops = token_sweep_flops_per_chunk(

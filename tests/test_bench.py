@@ -1,0 +1,26 @@
+"""bench.py end-to-end on CPU with a tiny preset: the driver-recorded artifact
+must never die on a plain Python error (a NameError in the FLOPs block once
+slipped past unit tests because only the TPU path ran it)."""
+import json
+import sys
+
+import pytest
+
+
+def test_bench_main_emits_one_json_line(monkeypatch, capsys):
+    sys.modules.pop("bench", None)
+    import bench
+
+    monkeypatch.setenv("BENCH_MODEL", "tiny-qwen2")
+    monkeypatch.setenv("BENCH_CHUNKS", "2")
+    monkeypatch.setenv("BENCH_WINDOW_BATCH", "2")
+    monkeypatch.setenv("BENCH_PALLAS", "0")
+    monkeypatch.setenv("BENCH_RELEVANCE", "0")
+    monkeypatch.setenv("BENCH_MEASURE_PEAK", "0")
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    line = json.loads(out[-1])
+    assert line["unit"] == "s/chunk" and line["value"] > 0
+    assert line["vs_baseline"] is None  # anchor is qwen2-0.5b only
+    assert line["window_batch"] == 2
+    assert "tiny-qwen2" in line["metric"]
