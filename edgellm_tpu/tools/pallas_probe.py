@@ -116,13 +116,10 @@ _N1, _N2 = 128, 2048
 #: a measured work delta below this is indistinguishable from call jitter
 _MIN_DELTA_S = 0.05
 
-#: bench-mode timing subset: one per wire-format family (per-token scale +
-#: fused pack, per-token affine, per-channel pack, the selective mixed codec).
-#: Parity always covers ALL of PROBE_CODECS; timing every codec's 8 scan
-#: executables would put the probe alone past the bench's time budget on the
-#: tunnel (compiles dominate). EDGELLM_PROBE_ALL=1 times everything.
-TIMED_CODECS = ("int4_per_token", "int8_per_token", "int4_per_channel",
-                "selective_int4_r0.5_bf16")
+# Bench mode times the encode->decode ROUNDTRIP of every codec (2 scan
+# executables per codec — separate encode/decode timing would double the
+# compile count and put the probe past the bench's time budget on the
+# tunnel). EDGELLM_PROBE_ALL=1 adds the separate encode/decode split.
 
 
 def _timed_scan(build_body, pool_tree, pool: int, lengths=None) -> float:
@@ -179,8 +176,8 @@ def _timed_scan(build_body, pool_tree, pool: int, lengths=None) -> float:
 
 
 def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
-                pool: int = 16, timing: bool = True, max_ulp: int = 2,
-                seed: int = 0) -> dict:
+                pool: int = 16, timing: bool = True, timing_detail: bool = False,
+                max_ulp: int = 2, seed: int = 0) -> dict:
     """Parity + throughput for one codec pair on the CURRENT default backend."""
     import jax
     import jax.numpy as jnp
@@ -213,8 +210,37 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
     if not timing:
         return result
 
+    import math
+
     in_bytes = int(np.prod(x.shape)) * 4
+    payload_bytes = result["payload_bytes"]
+    moved = 2 * (in_bytes + payload_bytes)  # enc: read+write, dec: read+write
     xs = jnp.asarray(rng.standard_normal((pool,) + x.shape).astype(np.float32))
+
+    def roundtrip(codec):
+        # return the payload ALONGSIDE the decoded output: _timed_scan folds
+        # every leaf of the returned tree into the carry, so even a payload
+        # leaf the decode side ignores cannot be dead-code-eliminated out of
+        # the timed body
+        def body(xi):
+            p = (codec.encode(xi, imp) if codec.needs_importance
+                 else codec.encode(xi))
+            return p, codec.decode(p)
+
+        return _timed_scan(body, xs, pool)
+
+    # a NaN differential means that body stayed inside the tunnel's call
+    # jitter even after escalation — omit its fields rather than emit a
+    # physically impossible rate (NaN would also break the JSON line)
+    t_rt_p, t_rt_j = roundtrip(pallas_codec), roundtrip(jnp_codec)
+    if math.isfinite(t_rt_p):
+        result["roundtrip_gbps"] = round(moved / t_rt_p / 1e9, 2)
+        result["roundtrip_us"] = round(t_rt_p * 1e6, 1)
+    if math.isfinite(t_rt_p) and math.isfinite(t_rt_j):
+        result["roundtrip_speedup_vs_jnp"] = round(t_rt_j / t_rt_p, 2)
+    if not timing_detail:
+        return result
+
     payloads = jax.vmap(jnp_codec.encode, in_axes=(0, None) if len(args) == 2
                         else 0)(*((xs, imp) if len(args) == 2 else (xs,)))
     jax.block_until_ready(payloads)
@@ -227,12 +253,6 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
     t_enc_p, t_enc_j = enc(pallas_codec), enc(jnp_codec)
     t_dec_p = _timed_scan(pallas_codec.decode, payloads, pool)
     t_dec_j = _timed_scan(jnp_codec.decode, payloads, pool)
-    payload_bytes = result["payload_bytes"]
-    # a NaN differential means that body stayed inside the tunnel's call
-    # jitter even after escalation — omit its fields rather than emit a
-    # physically impossible rate (NaN would also break the JSON line)
-    import math
-
     if math.isfinite(t_enc_p):
         result["encode_gbps"] = round((in_bytes + payload_bytes) / t_enc_p / 1e9, 2)
         result["encode_us"] = round(t_enc_p * 1e6, 1)
@@ -260,18 +280,20 @@ def probe_all(*, timing: Optional[bool] = None, batch: int = 8, seq: int = 512,
     on_tpu = jax.default_backend() == "tpu"
     if timing is None:
         timing = on_tpu
-    time_all = os.environ.get("EDGELLM_PROBE_ALL", "0") == "1"
+    detail = os.environ.get("EDGELLM_PROBE_ALL", "0") == "1"
     codecs = []
     for name in PROBE_CODECS:
         codecs.append(probe_codec(
             name, batch=batch, seq=seq, dim=dim, pool=pool,
-            timing=timing and (time_all or name in TIMED_CODECS)))
+            timing=timing, timing_detail=timing and detail))
     return {
         "backend": jax.default_backend(),
         "interpret": not on_tpu,
         "shape": [batch, seq, dim],
         "parity": "int leaves bit-identical; float leaves and decode <= 2 ulp",
-        "timed_subset": None if (not timing or time_all) else list(TIMED_CODECS),
+        "timing": None if not timing else (
+            "roundtrip per codec" + (" + encode/decode split" if detail else
+                                     " (EDGELLM_PROBE_ALL=1 adds the split)")),
         "codecs": codecs,
     }
 
