@@ -50,9 +50,43 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except ValueError as e:
+        # on TPU pods initialize() auto-discovers everything; elsewhere it
+        # demands a coordinator. With none configured (no args, no cluster
+        # environment) this is a single-process run — degrade instead of
+        # dying so `--distributed` scripts work unchanged on one host.
+        # Explicit args or any sign of an actual multi-host launch (cluster
+        # env vars whose auto-detect failed) still raise loudly: N workers
+        # silently proceeding as N independent "process 0 of 1" runs would
+        # write conflicting outputs.
+        if kwargs or "coordinator_address" not in str(e) or _in_cluster_env():
+            raise
+        import warnings
+
+        warnings.warn("initialize_distributed: no coordinator configured; "
+                      "continuing as a single process")
+        _initialized = True
+        return 1
     _initialized = True
     return jax.process_count()
+
+
+def _in_cluster_env() -> bool:
+    """Signs this process is part of a multi-host launch even though
+    coordinator auto-detection failed."""
+    import os
+
+    if int(os.environ.get("SLURM_NTASKS", "1") or 1) > 1:
+        return True
+    # a single-entry TPU_WORKER_HOSTNAMES (e.g. "localhost") is a one-host
+    # setup; only a multi-entry list implies a pod launch
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+        return True
+    return any(os.environ.get(k) for k in (
+        "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+        "MEGASCALE_COORDINATOR_ADDRESS"))
 
 
 def _slice_of(d) -> int:

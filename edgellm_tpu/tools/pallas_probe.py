@@ -232,7 +232,10 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
     # a NaN differential means that body stayed inside the tunnel's call
     # jitter even after escalation — omit its fields rather than emit a
     # physically impossible rate (NaN would also break the JSON line)
-    t_rt_p, t_rt_j = roundtrip(pallas_codec), roundtrip(jnp_codec)
+    t_rt_p = roundtrip(pallas_codec)
+    # the jnp ratio is only reportable against a finite pallas time — don't
+    # spend escalating tunnel calls on a value that could never be emitted
+    t_rt_j = roundtrip(jnp_codec) if math.isfinite(t_rt_p) else float("nan")
     if math.isfinite(t_rt_p):
         result["roundtrip_gbps"] = round(moved / t_rt_p / 1e9, 2)
         result["roundtrip_us"] = round(t_rt_p * 1e6, 1)

@@ -135,3 +135,49 @@ def test_initialize_distributed_wires_jax(monkeypatch):
     assert n == jax.process_count()
     dist.initialize_distributed()  # idempotent: no second call
     assert len(calls) == 1
+
+
+def test_initialize_without_coordinator_degrades_to_single_process(monkeypatch):
+    import edgellm_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    for k in ("SLURM_NTASKS", "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")  # one host: fine
+
+    def no_coordinator(**kw):
+        raise ValueError("coordinator_address should be defined.")
+
+    monkeypatch.setattr(jax.distributed, "initialize", no_coordinator)
+    with pytest.warns(UserWarning, match="single process"):
+        assert dist.initialize_distributed() == 1
+
+    # explicit args must still surface the failure
+    monkeypatch.setattr(dist, "_initialized", False)
+    with pytest.raises(ValueError):
+        dist.initialize_distributed("host:1", num_processes=2, process_id=0)
+
+
+def test_cluster_env_failure_still_raises(monkeypatch):
+    """Auto-detect failure INSIDE a real multi-host launch must not silently
+    degrade into N independent single-process runs."""
+    import edgellm_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: (_ for _ in ())
+                        .throw(ValueError("coordinator_address should be defined.")))
+    monkeypatch.setenv("SLURM_NTASKS", "4")
+    with pytest.raises(ValueError, match="coordinator_address"):
+        dist.initialize_distributed()
+
+
+def test_multihost_hostnames_list_still_raises(monkeypatch):
+    import edgellm_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host-0,host-1")
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: (_ for _ in ())
+                        .throw(ValueError("coordinator_address should be defined.")))
+    with pytest.raises(ValueError, match="coordinator_address"):
+        dist.initialize_distributed()
