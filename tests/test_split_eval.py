@@ -138,6 +138,27 @@ def test_checkpoint_resume_exact(setup, tmp_path):
     assert lines[-1]["final"] and lines[-1]["chunks"] == want["chunks"]
 
 
+def test_repeated_kill_resume_exact(setup, tmp_path):
+    """Three successive interruptions at different points, then completion:
+    the final totals must equal the uninterrupted run's exactly (the
+    reference's 9,347-chunk corpus makes multi-crash runs a realistic case)."""
+    params, corpus = setup
+    kw = dict(cuts=[2], hop_codecs=["int8_per_token"], max_length=16, stride=8,
+              window_batch=2, time_hops=False)
+    want = run_split_eval(CFG, params, corpus, **kw)
+
+    ckpt = str(tmp_path / "ckpt.json")
+    for stop_at in (2, 5, 9):
+        partial = run_split_eval(CFG, params, corpus, max_chunks=stop_at,
+                                 checkpoint_path=ckpt, checkpoint_every=1, **kw)
+        assert partial["chunks"] == stop_at
+    got = run_split_eval(CFG, params, corpus, checkpoint_path=ckpt,
+                         checkpoint_every=1, **kw)
+    assert got["chunks"] == want["chunks"]
+    assert got["measured_hop_bytes_total"] == want["measured_hop_bytes_total"]
+    np.testing.assert_allclose(got["ppl"], want["ppl"], rtol=1e-12)
+
+
 def test_checkpoint_axes_mismatch_raises(setup, tmp_path):
     params, corpus = setup
     ckpt = str(tmp_path / "ckpt.json")
