@@ -15,6 +15,7 @@ from .lib import (
     int8_per_channel_decode,
     int4_per_channel_encode,
     int4_per_channel_decode,
+    selective_int4_decode,
 )
 
 __all__ = [
@@ -28,4 +29,5 @@ __all__ = [
     "int8_per_channel_decode",
     "int4_per_channel_encode",
     "int4_per_channel_decode",
+    "selective_int4_decode",
 ]

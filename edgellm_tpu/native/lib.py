@@ -53,6 +53,9 @@ def _bind(lib):
     lib.int8_per_channel_decode.argtypes = [i8p, f32p, i64, i64, f32p]
     lib.int4_per_channel_encode.argtypes = [f32p, i64, i64, u8p, f32p]
     lib.int4_per_channel_decode.argtypes = [u8p, f32p, i64, i64, f32p]
+    u16p, i16p = ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_int16)
+    lib.selective_int4_decode.argtypes = [u8p, ctypes.c_float, u16p, i16p,
+                                          i64, i64, i64, i64, f32p]
     return lib
 
 
@@ -187,6 +190,52 @@ def int4_per_channel_encode(x: np.ndarray):
                                 _ptr(packed, ctypes.c_uint8),
                                 _ptr(scales, ctypes.c_float))
     return packed, scales
+
+
+def selective_int4_decode(low_packed: np.ndarray, scale: float,
+                          high_bf16: np.ndarray,
+                          low_idx: np.ndarray) -> np.ndarray:
+    """Reassemble a selective_int4 payload (shared-ordering wire format) on the
+    host: low nibbles (B, k, D/2) + global scale + position-ascending bf16 high
+    rows (B, S-k, D) + the int16 low-index side channel (k,) -> (B, S, D) fp32.
+    High placement is DERIVED as the sorted complement of the low set — the
+    independent C++ re-statement of the decode contract. Bit-identical to the
+    CPU jnp decode; a TPU decode may differ by 1 ulp on low rows (XLA fuses
+    the (c/7)*scale dequant differently on device)."""
+    lib = _require()
+    low_packed = np.ascontiguousarray(low_packed, np.uint8)
+    high_bf16 = np.ascontiguousarray(high_bf16)
+    if high_bf16.dtype != np.uint16:
+        raise ValueError("high rows must be raw-bf16 uint16 (use "
+                         "np.asarray(x).view(np.uint16) on a bfloat16 array)")
+    low_idx = np.asarray(low_idx)
+    if low_idx.ndim != 1:
+        raise ValueError(
+            f"per-row payloads (order shape {low_idx.shape}) are the "
+            f"data-parallel wire format; this host oracle decodes the "
+            f"shared-ordering path only (1-D order)")
+    low_idx = np.ascontiguousarray(low_idx, np.int16)
+    b, k, half = low_packed.shape
+    bh, s_minus_k, d = high_bf16.shape
+    if bh != b:
+        raise ValueError(f"low batch {b} != high batch {bh}")
+    if k and half * 2 != d:
+        raise ValueError(f"low dim {half * 2} != high dim {d}")
+    if low_idx.size != k:
+        raise ValueError(f"order carries {low_idx.size} indices, low rows {k}")
+    s = k + s_minus_k
+    # wire indices come off-fabric (DCN / file spills): validate before the
+    # C++ tight loop scatters through them
+    if k and (low_idx.min() < 0 or low_idx.max() >= s
+              or np.unique(low_idx).size != k):
+        raise ValueError(f"corrupt low-index side channel: {k} indices must be "
+                         f"unique and within [0, {s})")
+    out = np.empty((b, s, d), np.float32)
+    lib.selective_int4_decode(
+        _ptr(low_packed, ctypes.c_uint8), ctypes.c_float(float(scale)),
+        _ptr(high_bf16, ctypes.c_uint16), _ptr(low_idx, ctypes.c_int16),
+        b, s, k, d, _ptr(out, ctypes.c_float))
+    return out
 
 
 def int4_per_channel_decode(packed: np.ndarray, scales: np.ndarray) -> np.ndarray:

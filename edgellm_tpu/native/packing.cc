@@ -159,4 +159,51 @@ void int4_per_channel_decode(const uint8_t* packed, const float* scales,
   }
 }
 
+// selective_int4 wire-format decode (shared-ordering path): reassemble one
+// batch of windows from the COMPACTED buffers. The side channel ships ONLY
+// the k low-token indices (int16); high rows arrive position-ascending, so
+// their placement is derived here as the sorted complement of the low-index
+// set — the other half of the contract packing.selective_int4 encodes.
+//   low_packed: (batch, k, dim/2) int4 nibbles, contiguous-half layout
+//   scale:      one global fp32 scale over the selected slice
+//   high_bf16:  (batch, s-k, dim) bfloat16 as raw uint16
+//   low_idx:    (k,) int16 token positions of the low rows
+//   out:        (batch, s, dim) fp32
+void selective_int4_decode(const uint8_t* low_packed, float scale,
+                           const uint16_t* high_bf16, const int16_t* low_idx,
+                           int64_t batch, int64_t s, int64_t k, int64_t dim,
+                           float* out) {
+  const int64_t half = dim / 2;
+  bool* taken = new bool[s]();
+  for (int64_t i = 0; i < k; ++i) taken[low_idx[i]] = true;
+  for (int64_t b = 0; b < batch; ++b) {
+    float* ob = out + b * s * dim;
+    // low rows: int4 dequantize into their shipped positions
+    for (int64_t i = 0; i < k; ++i) {
+      const uint8_t* row = low_packed + (b * k + i) * half;
+      float* o = ob + static_cast<int64_t>(low_idx[i]) * dim;
+      for (int64_t j = 0; j < half; ++j) {
+        o[j] = static_cast<float>((row[j] & 0xF) - 8) / 7.0f * scale;
+        o[j + half] = static_cast<float>(((row[j] >> 4) & 0xF) - 8) / 7.0f * scale;
+      }
+    }
+    // high rows: walk positions ascending, fill every non-low slot from the
+    // next high row (bf16 -> fp32 is exact: the top 16 bits of the float)
+    int64_t h = 0;
+    for (int64_t pos = 0; pos < s; ++pos) {
+      if (taken[pos]) continue;
+      const uint16_t* row = high_bf16 + (b * (s - k) + h) * dim;
+      float* o = ob + pos * dim;
+      for (int64_t j = 0; j < dim; ++j) {
+        const uint32_t bits = static_cast<uint32_t>(row[j]) << 16;
+        float v;
+        std::memcpy(&v, &bits, sizeof(v));
+        o[j] = v;
+      }
+      ++h;
+    }
+  }
+  delete[] taken;
+}
+
 }  // extern "C"

@@ -72,3 +72,56 @@ def test_int4_per_channel_bitwise_matches_jax(rng):
     out = native.int4_per_channel_decode(packed_c, scales_c)
     codec = get_wire_codec("int4_per_channel")
     np.testing.assert_allclose(out, np.asarray(codec.decode(want))[0], atol=1e-6)
+
+
+@pytest.mark.parametrize("ratio", [0.0, 0.25, 0.5, 1.0])
+def test_selective_decode_matches_jax_bitwise(rng, ratio):
+    """The C++ oracle reassembles a JAX-encoded selective_int4 payload —
+    including deriving the high-row placement from the int16 low-index side
+    channel — bit-identically to the CPU JAX decode (a TPU decode may differ
+    by 1 ulp on the dequantized low rows; the suite runs on CPU)."""
+    from edgellm_tpu.codecs.packing import selective_int4
+
+    h = jnp.asarray(rng.normal(size=(2, 16, 32)).astype(np.float32))
+    imp = jnp.asarray(rng.random(16).astype(np.float32))
+    codec = selective_int4(ratio, "bf16")
+    payload = codec.encode(h, imp)
+    want = np.asarray(codec.decode(payload))
+
+    got = native.selective_int4_decode(
+        np.asarray(payload["low"]),
+        float(np.asarray(payload["scale"])[0]),
+        np.asarray(payload["high"]).view(np.uint16),
+        np.asarray(payload["order"]))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_selective_decode_rejects_bad_payloads(rng):
+    """Wire payloads arrive off-fabric: per-row orders, corrupt indices, and
+    mismatched batches must be rejected before the C++ scatter."""
+    from edgellm_tpu.codecs.packing import selective_int4
+
+    h = jnp.asarray(rng.normal(size=(2, 16, 32)).astype(np.float32))
+    per_row = selective_int4(0.5, "bf16").encode(
+        h, jnp.asarray(rng.random((2, 16)).astype(np.float32)))
+    with pytest.raises(ValueError, match="shared-ordering"):
+        native.selective_int4_decode(
+            np.asarray(per_row["low"]), 1.0,
+            np.asarray(per_row["high"]).view(np.uint16),
+            np.asarray(per_row["order"]))
+
+    shared = selective_int4(0.5, "bf16").encode(
+        h, jnp.asarray(rng.random(16).astype(np.float32)))
+    low = np.asarray(shared["low"])
+    high = np.asarray(shared["high"]).view(np.uint16)
+    bad = np.asarray(shared["order"]).copy()
+    bad[0] = 99  # out of range for S=16
+    with pytest.raises(ValueError, match="corrupt"):
+        native.selective_int4_decode(low, 1.0, high, bad)
+    dup = np.asarray(shared["order"]).copy()
+    dup[0] = dup[1]
+    with pytest.raises(ValueError, match="corrupt"):
+        native.selective_int4_decode(low, 1.0, high, dup)
+    with pytest.raises(ValueError, match="batch"):
+        native.selective_int4_decode(low, 1.0, high[:1],
+                                     np.asarray(shared["order"]))
