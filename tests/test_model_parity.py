@@ -174,3 +174,26 @@ class TestBlockedTailCE:
         with pytest.raises(ValueError, match="divide"):
             nll_tail(cfg, params, jnp.zeros((1, 8, 32)), jnp.zeros((1, 8), int),
                      tail=3, vocab_block=33)
+
+
+def test_auto_blocked_ce_at_realistic_vocab(rng):
+    """At real vocabulary sizes the AUTO path streams (Pythia's 50304 -> 8
+    blocks of 6288); it must equal the full-logits oracle. Tiny-model tests
+    never reach this branch (small vocabs stay single-block)."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import tiny_config, init_params
+    from edgellm_tpu.models.transformer import _vocab_block_size, nll_tail
+
+    cfg = tiny_config("qwen2", num_layers=1, hidden_size=32, num_heads=4,
+                      vocab_size=50304)
+    assert _vocab_block_size(cfg.vocab_size) == 6288  # auto path really blocks
+    params = init_params(cfg, jax.random.key(9))
+    hidden = jnp.asarray(rng.normal(size=(2, 12, 32)).astype(np.float32))
+    targets = np.asarray(rng.integers(0, cfg.vocab_size, (2, 12)))
+    targets[:, :8] = -100
+    targets = jnp.asarray(targets)
+    want = nll_tail(cfg, params, hidden, targets, tail=5, vocab_block=0)
+    got = nll_tail(cfg, params, hidden, targets, tail=5)  # auto
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-5, rtol=1e-5)
