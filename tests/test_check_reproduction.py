@@ -1,0 +1,82 @@
+"""The north-star checker: golden-table matching, tolerance semantics, and
+the CLI contract (exit 0 only when every stable anchor is within ±0.1)."""
+import json
+
+import pytest
+
+from edgellm_tpu.tools.check_reproduction import GOLDEN, check, main
+
+
+def synth_result(perturb=0.0, collapse_factor=1.0):
+    """A result dict whose cells equal the golden values (optionally off)."""
+    methods = ["regular_importance", "last_row"]
+    layers = [22, 18, 3, 23, 11]
+    ratios = [0.0, 0.25, 0.5, 0.75, 1.0]
+    ppl = [[[13.31 for _ in ratios] for _ in layers] for _ in methods]
+    for method, layer, ratio, want, kind in GOLDEN:
+        m, l, r = methods.index(method), layers.index(layer), ratios.index(ratio)
+        ppl[m][l][r] = want * collapse_factor if kind == "collapse" \
+            else want + perturb
+    return {"axes": {"methods": methods, "layers_of_interest": layers,
+                     "ratios": ratios}, "ppl": ppl}
+
+
+def test_exact_result_passes():
+    rows, failed = check(synth_result())
+    assert failed == 0 and len(rows) == len(GOLDEN)
+
+
+def test_within_tolerance_passes():
+    _, failed = check(synth_result(perturb=0.09))
+    assert failed == 0
+
+
+def test_outside_tolerance_fails():
+    rows, failed = check(synth_result(perturb=0.2))
+    assert failed == sum(1 for *_abc, kind in GOLDEN if kind == "abs")
+    assert any(not r["ok"] for r in rows)
+
+
+def test_collapse_cells_check_factor_not_abs():
+    _, failed = check(synth_result(collapse_factor=1.8))
+    assert failed == 0  # within 2x: the collapse reproduced
+    _, failed = check(synth_result(collapse_factor=3.0))
+    assert failed == sum(1 for *_abc, kind in GOLDEN if kind == "collapse")
+
+
+def test_partial_axes_skip_missing_cells():
+    res = synth_result()
+    res["axes"]["layers_of_interest"] = [3]  # keep the layer-3 column only
+    res["ppl"] = [[method_ppl[2]] for method_ppl in res["ppl"]]
+    rows, failed = check(res)
+    assert failed == 0 and rows and all(r["layer"] == 3 for r in rows)
+
+
+def test_cli_contract(tmp_path, capsys):
+    path = tmp_path / "avg_ppl_results.json"
+    path.write_text(json.dumps(synth_result()))
+    assert main([str(path)]) == 0
+    assert "anchors reproduced" in capsys.readouterr().out
+
+    path.write_text(json.dumps(synth_result(perturb=0.5)))
+    assert main([str(path)]) == 1
+    assert "FAIL" in capsys.readouterr().out
+
+    empty = synth_result()
+    empty["axes"]["methods"] = ["aggregate_till"]
+    path.write_text(json.dumps(empty))
+    assert main([str(path)]) == 2  # no matching cells: tell the user which config
+
+
+def test_non_token_sweep_results_get_guidance_not_traceback(tmp_path, capsys):
+    """Channel/initial sweeps write the same filename with different axes."""
+    path = tmp_path / "avg_ppl_results.json"
+    path.write_text(json.dumps({  # channel sweep: no ratios axis
+        "axes": {"methods": ["channel_8"], "layers_of_interest": [2]},
+        "ppl": [[20.0]]}))
+    assert main([str(path)]) == 2
+    assert "no golden cells" in capsys.readouterr().out
+    path.write_text(json.dumps({  # initial sweep: no methods, magic strings
+        "axes": {"layers_of_interest": [1, "aggregate upto 2"],
+                 "ratios": [0, 5]}, "ppl": [[1.0, 2.0], [3.0, 4.0]]}))
+    assert main([str(path)]) == 2
