@@ -124,3 +124,20 @@ def test_split_eval_from_checkpoint_dir(ckpt_dir, tmp_path):
     result = json.load(open(out / "split_eval_results.json"))
     assert np.isfinite(result["ppl"])
     assert result["bytes_per_token_per_hop"][0] > 0
+
+
+def test_ring_long_context_split_cli(ckpt_dir, tmp_path):
+    """The stage x seq long-context path end to end from the CLI (the shape of
+    configs/split5_qwen_ring_long.json on the synthesized checkpoint): seq
+    sharded within each stage, windows right-padded to a shardable length."""
+    out = tmp_path / "out_ring"
+    params = _params(tmp_path, {
+        "experiment": "split", "cuts": [2], "hop_codecs": ["int4_per_token"],
+        "max_length": 44, "stride": 22, "n_seq": 3})
+    main(["--params", params, "--weights", ckpt_dir["model_dir"],
+          "--corpus", ckpt_dir["corpus"], "--output-dir", str(out),
+          "--max-chunks", "4"])
+    result = json.load(open(out / "split_eval_results.json"))
+    assert np.isfinite(result["ppl"])
+    assert result["mesh"] == {"stage": 2, "seq": 3}
+    assert result["pad_fraction"] > 0  # 44 % 3 != 0: the padding path ran

@@ -224,3 +224,58 @@ def test_run_with_oom_backoff():
 
     with pytest.raises(ValueError, match="boom"):
         run_with_oom_backoff(other, 8)
+
+
+class TestResumableDriver:
+    """The shared resumable scaffold, unit-tested directly (the drivers cover
+    it end to end; these pin the contract new drivers build on)."""
+
+    class FakeChunk:
+        def __init__(self, index):
+            self.index = index
+
+    def test_fresh_start(self, tmp_path):
+        from edgellm_tpu.eval.harness import ResumableDriver
+
+        rd = ResumableDriver(str(tmp_path / "c.json"), {"a": 1}, 2)
+        assert rd.state is None and rd.chunks == 0 and rd.start_chunk == 0
+        assert rd.remaining(None) is None and rd.remaining(5) == 5
+
+    def test_advance_trigger_and_roundtrip(self, tmp_path):
+        from edgellm_tpu.eval.harness import ResumableDriver
+
+        path = str(tmp_path / "c.json")
+        rd = ResumableDriver(path, {"a": 1}, checkpoint_every=3)
+        group = [self.FakeChunk(0), self.FakeChunk(1)]
+        assert rd.advance(group) is False  # 2 < 3
+        assert rd.advance([self.FakeChunk(2)]) is True  # 3 >= 3
+        rd.save({"extra": 7})
+        assert rd.advance([self.FakeChunk(3)]) is False  # trigger reset
+
+        rd2 = ResumableDriver(path, {"a": 1}, 3)
+        assert rd2.state["extra"] == 7
+        assert rd2.chunks == 3 and rd2.start_chunk == 3
+        assert rd2.remaining(10) == 7
+        # wall accumulates across resumes: the reloaded prior_wall carries the
+        # first run's elapsed time (strictly positive), and wall() adds to it
+        assert rd2.prior_wall > 0
+        assert rd2.wall() >= rd2.prior_wall
+
+    def test_count_override_excludes_pad_windows(self, tmp_path):
+        from edgellm_tpu.eval.harness import ResumableDriver
+
+        rd = ResumableDriver(None, {}, 2)  # no checkpoint path: save is a no-op
+        padded_group = [self.FakeChunk(0), self.FakeChunk(1), self.FakeChunk(1)]
+        rd.advance(padded_group, count=2)
+        assert rd.chunks == 2 and rd.next_chunk == 2
+        rd.save({})  # must not touch the filesystem
+
+    def test_axes_mismatch_rejected(self, tmp_path):
+        from edgellm_tpu.eval.harness import ResumableDriver
+
+        path = str(tmp_path / "c.json")
+        rd = ResumableDriver(path, {"a": 1}, 1)
+        rd.advance([self.FakeChunk(0)])
+        rd.save({})
+        with pytest.raises(ValueError, match="different sweep configuration"):
+            ResumableDriver(path, {"a": 2}, 1)
