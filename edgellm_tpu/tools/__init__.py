@@ -1,1 +1,10 @@
-"""Offline data/corpus preparation utilities."""
+"""Operational tooling around the framework core.
+
+- ``prepare_wikitext``: reference-exact corpus tokenization (join + tokenize).
+- ``pallas_probe``: on-silicon codec parity + throughput (the bench's
+  ``"pallas"`` block) and the differential-scan timing harness.
+- ``wb_preflight``: AOT memory-analysis window-batch preflight (never OOM the
+  device allocator).
+- ``check_reproduction``: machine-check a sweep against the reference's
+  golden PPL anchors (the REPRODUCING.md north star).
+"""
