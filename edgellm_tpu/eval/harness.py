@@ -50,7 +50,19 @@ TOKEN_CODECS = ("int4_token_select", "affine_int8_rank", "affine_int8_top_rho")
 
 
 def is_oom_error(e: BaseException) -> bool:
-    """True for XLA device-memory exhaustion (any backend's phrasing)."""
+    """True for XLA device-memory exhaustion (any backend's phrasing).
+
+    Only runtime-launch errors qualify: the message heuristic alone would let
+    any exception that merely *mentions* "out of memory" (a wrapped host OOM,
+    a quoted log line) trigger a halve-and-retry and mask the real failure.
+    ``XlaRuntimeError`` isn't a stable public import path across jaxlib
+    versions, so match the class name up the MRO instead of the type.
+    """
+    if isinstance(e, MemoryError):  # host allocator exhaustion (often bare)
+        return True
+    names = {c.__name__ for c in type(e).__mro__}
+    if not {"XlaRuntimeError", "JaxRuntimeError"} & names:
+        return False
     msg = str(e)
     return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
             or "out of memory" in msg)

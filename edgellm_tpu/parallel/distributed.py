@@ -52,7 +52,7 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         kwargs["process_id"] = process_id
     try:
         jax.distributed.initialize(**kwargs)
-    except ValueError as e:
+    except (ValueError, RuntimeError) as e:
         # on TPU pods initialize() auto-discovers everything; elsewhere it
         # demands a coordinator. With none configured (no args, no cluster
         # environment) this is a single-process run — degrade instead of
@@ -60,8 +60,12 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         # Explicit args or any sign of an actual multi-host launch (cluster
         # env vars whose auto-detect failed) still raise loudly: N workers
         # silently proceeding as N independent "process 0 of 1" runs would
-        # write conflicting outputs.
-        if kwargs or "coordinator_address" not in str(e) or _in_cluster_env():
+        # write conflicting outputs. The match is loose about exception type
+        # and phrasing (both have drifted across JAX versions) but must
+        # indicate a MISSING coordinator configuration — a coordinator
+        # *connect* failure ("failed to connect to coordinator ...") is a real
+        # broken launch and propagates.
+        if kwargs or not _is_missing_coordinator(e) or _in_cluster_env():
             raise
         import warnings
 
@@ -73,13 +77,30 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     return jax.process_count()
 
 
+def _is_missing_coordinator(e: BaseException) -> bool:
+    """True when ``jax.distributed.initialize()`` failed because no coordinator
+    was CONFIGURED (the benign single-host case), as opposed to a configured
+    coordinator that could not be reached."""
+    msg = str(e).lower()
+    if "coordinator" not in msg:
+        return False
+    return any(w in msg for w in ("defined", "specified", "configured",
+                                  "required", "missing", "not set"))
+
+
 def _in_cluster_env() -> bool:
     """Signs this process is part of a multi-host launch even though
     coordinator auto-detection failed."""
     import os
 
-    if int(os.environ.get("SLURM_NTASKS", "1") or 1) > 1:
-        return True
+    # world-size style launchers: slurm, mpirun/OpenMPI, PMI, torchrun-style
+    for var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                "WORLD_SIZE"):
+        try:
+            if int(os.environ.get(var, "1") or 1) > 1:
+                return True
+        except ValueError:
+            pass
     # a single-entry TPU_WORKER_HOSTNAMES (e.g. "localhost") is a one-host
     # setup; only a multi-entry list implies a pod launch
     if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):

@@ -181,3 +181,52 @@ def test_multihost_hostnames_list_still_raises(monkeypatch):
                         .throw(ValueError("coordinator_address should be defined.")))
     with pytest.raises(ValueError, match="coordinator_address"):
         dist.initialize_distributed()
+
+
+@pytest.mark.parametrize("var", ["OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                                 "WORLD_SIZE", "SLURM_NTASKS"])
+def test_world_size_launchers_still_raise(monkeypatch, var):
+    """mpirun/PMI/torchrun-style world-size vars count as a cluster launch:
+    auto-detect failure must raise, not degrade to N process-0 runs."""
+    import edgellm_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    for k in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "WORLD_SIZE",
+              "TPU_WORKER_HOSTNAMES", "JAX_COORDINATOR_ADDRESS",
+              "JAX_NUM_PROCESSES", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv(var, "2")
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: (_ for _ in ())
+                        .throw(ValueError("coordinator_address should be defined.")))
+    with pytest.raises(ValueError, match="coordinator_address"):
+        dist.initialize_distributed()
+
+    # size 1 is not a cluster: degrade normally
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setenv(var, "1")
+    with pytest.warns(UserWarning, match="single process"):
+        assert dist.initialize_distributed() == 1
+
+
+def test_runtime_error_coordinator_also_degrades(monkeypatch):
+    """JAX version drift: a RuntimeError mentioning the coordinator (rather
+    than ValueError/'coordinator_address') still takes the single-host path."""
+    import edgellm_tpu.parallel.distributed as dist
+
+    monkeypatch.setattr(dist, "_initialized", False)
+    for k in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "WORLD_SIZE",
+              "TPU_WORKER_HOSTNAMES", "JAX_COORDINATOR_ADDRESS",
+              "JAX_NUM_PROCESSES", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: (_ for _ in ())
+                        .throw(RuntimeError("no coordinator configured")))
+    with pytest.warns(UserWarning, match="single process"):
+        assert dist.initialize_distributed() == 1
+
+    # a coordinator CONNECT failure is a broken launch, never a degrade
+    monkeypatch.setattr(dist, "_initialized", False)
+    monkeypatch.setattr(jax.distributed, "initialize", lambda **kw: (_ for _ in ())
+                        .throw(RuntimeError(
+                            "failed to connect to coordinator at 10.0.0.2:1234")))
+    with pytest.raises(RuntimeError, match="failed to connect"):
+        dist.initialize_distributed()

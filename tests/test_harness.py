@@ -198,23 +198,26 @@ def test_initial_window_batching_is_exact(setup):
 
 
 def test_run_with_oom_backoff():
-    """RESOURCE_EXHAUSTED halves the window batch until it fits; other errors
-    propagate untouched."""
+    """RESOURCE_EXHAUSTED from the XLA runtime halves the window batch until it
+    fits; other errors — including non-runtime exceptions whose message merely
+    mentions memory — propagate untouched."""
+    import jax
     from edgellm_tpu.eval.harness import run_with_oom_backoff
 
+    oom = jax.errors.JaxRuntimeError  # name-matched up the MRO by is_oom_error
     calls = []
 
     def run(wb):
         calls.append(wb)
         if wb > 2:
-            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating ...")
+            raise oom("RESOURCE_EXHAUSTED: Out of memory allocating ...")
         return "ok"
 
     result, wb = run_with_oom_backoff(run, 8)
     assert result == "ok" and wb == 2 and calls == [8, 4, 2]
 
     def always_oom(wb):
-        raise RuntimeError("RESOURCE_EXHAUSTED")
+        raise oom("RESOURCE_EXHAUSTED")
 
     with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
         run_with_oom_backoff(always_oom, 4)  # min batch reached -> re-raise
@@ -224,6 +227,13 @@ def test_run_with_oom_backoff():
 
     with pytest.raises(ValueError, match="boom"):
         run_with_oom_backoff(other, 8)
+
+    def fake_oom(wb):
+        # an arbitrary exception that merely *mentions* OOM must not back off
+        raise RuntimeError("subprocess log said: out of memory")
+
+    with pytest.raises(RuntimeError, match="subprocess log"):
+        run_with_oom_backoff(fake_oom, 8)
 
 
 class TestResumableDriver:
