@@ -13,7 +13,7 @@ Every ``*_pallas`` wire codec the split runtime auto-substitutes on TPU
   the fused-vs-unfused speedup is recorded per codec.
 
 The result is a JSON-able dict that ``bench.py`` embeds as the ``"pallas"``
-block of the bench line — the driver-captured artifact VERDICT r2 asked for
+block of the bench detail line and sidecar — the artifact VERDICT r2 asked for
 (kernels lower through Mosaic, match on hardware, and their throughput is
 pinned). The same probe runs in the test suite on CPU (interpret mode) so the
 parity logic itself is covered without a chip.
@@ -271,7 +271,7 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
 
 def probe_all(*, timing: Optional[bool] = None, batch: int = 8, seq: int = 512,
               dim: int = 896, pool: int = 16) -> dict:
-    """The ``"pallas"`` bench block: every substituted codec, parity + GB/s.
+    """The ``"pallas"`` bench detail block: every substituted codec, parity + GB/s.
 
     ``timing=None`` enables timing only on a real TPU backend (interpret-mode
     timings would be meaningless).

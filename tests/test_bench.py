@@ -7,10 +7,11 @@ import sys
 import pytest
 
 
-def test_bench_main_emits_one_json_line(monkeypatch, capsys):
+def test_bench_main_headline_is_final_compact_line(monkeypatch, capsys, tmp_path):
     sys.modules.pop("bench", None)
     import bench
 
+    monkeypatch.setenv("BENCH_DETAIL_PATH", str(tmp_path / "detail.json"))
     monkeypatch.setenv("BENCH_MODEL", "tiny-qwen2")
     monkeypatch.setenv("BENCH_CHUNKS", "2")
     monkeypatch.setenv("BENCH_WINDOW_BATCH", "2")
@@ -24,3 +25,14 @@ def test_bench_main_emits_one_json_line(monkeypatch, capsys):
     assert line["vs_baseline"] is None  # anchor is qwen2-0.5b only
     assert line["window_batch"] == 2
     assert "tiny-qwen2" in line["metric"]
+    # the FINAL line is the compact headline (the driver's tail capture
+    # truncates giant lines); verbose blocks ride the preceding detail line
+    # and the sidecar. A closed key set keeps future verbose additions out.
+    assert len(out[-1]) < 1024
+    assert set(line) <= {
+        "metric", "value", "unit", "vs_baseline", "tokens_per_s",
+        "window_batch", "model_tflops_per_s", "mfu", "measured_peak_tflops",
+        "mfu_vs_measured", "relevance_it_per_s", "relevance_vs_baseline"}
+    detail = json.loads(out[-2])["detail"]
+    assert detail["requested_window_batch"] == 2
+    assert json.load(open(tmp_path / "detail.json")) == detail
