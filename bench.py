@@ -199,10 +199,10 @@ def main():
 
     detail_path = os.environ.get("BENCH_DETAIL_PATH", "BENCH_DETAIL.json")
     try:
-        tmp = detail_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(detail, f, indent=1)
-        os.replace(tmp, detail_path)  # atomic: never a half-written sidecar
+        # the harness's atomic tmp+rename writer: never a half-written sidecar
+        from edgellm_tpu.eval.harness import _save_checkpoint_state
+
+        _save_checkpoint_state(detail_path, detail)
     except OSError as e:
         import sys
 

@@ -417,9 +417,9 @@ def fetch_global(x) -> np.ndarray:
 class ResumableDriver:
     """The shared resumable-driver scaffold: axes-validated checkpoint load,
     atomic save, cumulative wall-clock across resumes, and the
-    ``checkpoint_every`` trigger. New drivers should use this rather than
-    re-implementing the bookkeeping (split eval and relevance do; the older
-    sweep drivers predate it).
+    ``checkpoint_every`` trigger. Every resumable driver sits on it: split
+    eval and relevance directly, the three sweep drivers via
+    :func:`_run_accumulator_sweep`.
 
     ``state`` holds the loaded checkpoint dict (None on a fresh start) for
     driver-specific fields; ``save(extra)`` persists them alongside the
@@ -462,18 +462,78 @@ class ResumableDriver:
         return None if max_chunks is None else max_chunks - self.chunks
 
 
-def _save_checkpoint(path: Optional[str], result: SweepResult, next_chunk: int):
-    _save_checkpoint_state(path, {
-        "next_chunk": next_chunk, "axes": result.axes,
-        "total_nll": result.total_nll.tolist(),
-        "n_tokens": result.n_tokens, "chunks": result.chunks})
-
-
 def _emit(metrics_path: Optional[str], record: dict):
     if not metrics_path or jax.process_index() != 0:
         return
     with open(metrics_path, "a") as f:
         f.write(json.dumps(record) + "\n")
+
+
+def _run_accumulator_sweep(result: SweepResult, token_ids: np.ndarray, *,
+                           max_length: int, stride: int, window_batch: int,
+                           submit: Callable, accumulate: Callable,
+                           checkpoint_path: Optional[str],
+                           checkpoint_every: int,
+                           metrics_path: Optional[str],
+                           max_chunks: Optional[int],
+                           progress: Optional[Callable[[int], None]] = None,
+                           emit_tokens: bool = False) -> SweepResult:
+    """One implementation of the sweep-driver loop, shared by the three
+    array-accumulator drivers (token / initial / channel) on top of
+    :class:`ResumableDriver` — exact resume, atomic checkpoints, cumulative
+    wall clock, pipelined submit/drain (reference checkpoint intent:
+    ``Qwen2-0.5B/main.py:184-192``, previously hand-rolled per driver).
+
+    ``submit(ids, targets, tail) -> pending`` enqueues one window group's
+    device work with no host sync; ``accumulate(pending, counts)`` folds the
+    drained results into ``result.total_nll``. ``emit_tokens`` adds the
+    running token count to metrics records (the token sweep's historical
+    schema).
+    """
+    drv = ResumableDriver(checkpoint_path, result.axes, checkpoint_every)
+    if drv.state is not None:
+        result.total_nll = np.asarray(drv.state["total_nll"])
+        result.n_tokens = drv.state["n_tokens"]
+        result.chunks = drv.chunks
+
+    def save():
+        drv.save({"total_nll": result.total_nll.tolist(),
+                  "n_tokens": result.n_tokens})
+
+    def submit_group(group):
+        ids, targets, counts, tail = _group_arrays(group)
+        return group, counts, submit(ids, targets, tail)
+
+    def drain_group(rec):
+        group, counts, pending = rec
+        accumulate(pending, counts)
+        result.n_tokens += counts.sum()
+        due = drv.advance(group)
+        result.chunks = drv.chunks
+        if progress:
+            progress(group[-1].index)
+        if due:
+            save()
+            record = {"chunk": group[-1].index}
+            if emit_tokens:
+                record["n_tokens"] = result.n_tokens
+            _emit(metrics_path, {**record, "ppl": result.ppl().tolist()})
+
+    _run_pipelined(
+        _iter_window_groups(token_ids, max_length, stride,
+                            window_batch=window_batch,
+                            start_chunk=drv.start_chunk,
+                            max_count=drv.remaining(max_chunks),
+                            tail_of=_scoring_tail),
+        submit_group, drain_group)
+    result.wall_s = drv.wall()
+    save()
+    final = {"final": True, "chunks": result.chunks}
+    if emit_tokens:
+        final["n_tokens"] = result.n_tokens
+    _emit(metrics_path, {**final, "ppl": result.ppl().tolist(),
+                         "wall_s": result.wall_s})
+    return result
 
 
 def run_token_sweep(
@@ -516,11 +576,6 @@ def run_token_sweep(
         axes={"methods": list(methods), "layers_of_interest": list(layers_of_interest),
               "ratios": list(ratios)},
         total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="token_weighted")
-    start_chunk = 0
-    if (state := _load_checkpoint(checkpoint_path, result.axes)) is not None:
-        result.total_nll = np.asarray(state["total_nll"])
-        result.n_tokens, result.chunks = state["n_tokens"], state["chunks"]
-        start_chunk = state["next_chunk"]
 
     hw = None if head_weights is None else jnp.asarray(head_weights)
     # ratio == 0 is the fp baseline: method-independent for the rank codecs, so
@@ -531,14 +586,10 @@ def run_token_sweep(
     nz_ratios = jnp.asarray(np.asarray([ratios[i] for i in nz_idx], np.float32))
     stats_fn = _stats_forward(cfg)
     imp_fn = _importance_stack(cfg, tuple(methods))
-    t0 = time.monotonic()
-    next_chunk = start_chunk
-    last_ckpt = result.chunks
 
-    def submit_group(group):
+    def submit(ids, targets, tail):
         """Enqueue all of one group's device work; NO host sync — returns the
         device result handles for a later drain."""
-        ids, targets, counts, tail = _group_arrays(group)
         # k per ratio, truncated in Python float64 exactly like the reference's
         # int(ratio * s) (qwen_layer_wise.py:57) and the wire codecs
         ks = jnp.asarray([int(float(ratios[i]) * ids.shape[1]) for i in nz_idx],
@@ -556,40 +607,20 @@ def run_token_sweep(
                     nlls = _suffix_sweep(cfg, int(layer), codec, tail)(
                         params, h_l, targets, imp_all[m, layer], nz_ratios, ks)  # (R', W)
                     pending.append(([m], l, nz_idx, nlls))
-        return group, counts, pending
+        return pending
 
-    def drain_group(rec):
-        """Accumulate one submitted group (host syncs happen here, one group
-        behind submission so conversions overlap the next group's compute)."""
-        nonlocal next_chunk, last_ckpt
-        group, counts, pending = rec
+    def accumulate(pending, counts):
         for ms, l, r_idx, nlls in pending:
             contrib = np.asarray(nlls, np.float64) @ counts  # (R',)
             for m in ms:
                 result.total_nll[m, l, r_idx] += contrib
-        result.n_tokens += counts.sum()
-        result.chunks += len(group)
-        next_chunk = group[-1].index + 1
-        if progress:
-            progress(group[-1].index)
-        if result.chunks - last_ckpt >= checkpoint_every:
-            last_ckpt = result.chunks
-            _save_checkpoint(checkpoint_path, result, next_chunk)
-            _emit(metrics_path, {"chunk": group[-1].index, "n_tokens": result.n_tokens,
-                                 "ppl": result.ppl().tolist()})
 
-    remaining = None if max_chunks is None else max_chunks - result.chunks
-    _run_pipelined(
-        _iter_window_groups(token_ids, max_length, stride,
-                            window_batch=window_batch, start_chunk=start_chunk,
-                            max_count=remaining, tail_of=_scoring_tail),
-        submit_group, drain_group)
-    result.wall_s = time.monotonic() - t0
-    _save_checkpoint(checkpoint_path, result, next_chunk)
-    _emit(metrics_path, {"final": True, "chunks": result.chunks,
-                         "n_tokens": result.n_tokens, "ppl": result.ppl().tolist(),
-                         "wall_s": result.wall_s})
-    return result
+    return _run_accumulator_sweep(
+        result, token_ids, max_length=max_length, stride=stride,
+        window_batch=window_batch, submit=submit, accumulate=accumulate,
+        checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        metrics_path=metrics_path, max_chunks=max_chunks, progress=progress,
+        emit_tokens=True)
 
 
 def run_initial_sweep(
@@ -630,21 +661,11 @@ def run_initial_sweep(
         axes={"layers_of_interest": [str(l) for l in layers_of_interest],
               "ratios": list(ratios)},
         total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="mean_of_means")
-    start_chunk = 0
-    if (state := _load_checkpoint(checkpoint_path, result.axes)) is not None:
-        result.total_nll = np.asarray(state["total_nll"])
-        result.n_tokens, result.chunks = state["n_tokens"], state["chunks"]
-        start_chunk = state["next_chunk"]
 
     fracs = jnp.asarray([0.1 * r for r in ratios], jnp.float32)
     stats_fn = _stats_forward(cfg)
-    t0 = time.monotonic()
-    next_chunk = start_chunk
-    last_ckpt = result.chunks
-    remaining = None if max_chunks is None else max_chunks - result.chunks
 
-    def submit_group(group):
-        ids, targets, counts, tail = _group_arrays(group)
+    def submit(ids, targets, tail):
         ks = jnp.asarray([int(0.1 * r * ids.shape[1]) for r in ratios], jnp.int32)
         stats, hiddens = stats_fn(params, ids)
         reg = regular_importance(stats.col_mean)  # (L, W, S)
@@ -660,32 +681,18 @@ def run_initial_sweep(
                 imp, codec = reg[int(spec)], "affine_int8_rank"
             pending.append((l, _suffix_sweep(cfg, quant_layer, codec, tail)(
                 params, hiddens[quant_layer], targets, imp, fracs, ks)))  # (R, W)
-        return group, counts, pending
+        return pending
 
-    def drain_group(rec):
-        nonlocal next_chunk, last_ckpt
-        group, counts, pending = rec
+    def accumulate(pending, counts):
         for l, nlls in pending:
             # unweighted mean-of-chunk-means: each window contributes equally
             result.total_nll[l] += np.asarray(nlls, np.float64).sum(axis=1)
-        result.n_tokens += counts.sum()
-        result.chunks += len(group)
-        next_chunk = group[-1].index + 1
-        if result.chunks - last_ckpt >= checkpoint_every:
-            last_ckpt = result.chunks
-            _save_checkpoint(checkpoint_path, result, next_chunk)
-            _emit(metrics_path, {"chunk": group[-1].index, "ppl": result.ppl().tolist()})
 
-    _run_pipelined(
-        _iter_window_groups(token_ids, max_length, stride,
-                            window_batch=window_batch, start_chunk=start_chunk,
-                            max_count=remaining, tail_of=_scoring_tail),
-        submit_group, drain_group)
-    result.wall_s = time.monotonic() - t0
-    _save_checkpoint(checkpoint_path, result, next_chunk)
-    _emit(metrics_path, {"final": True, "chunks": result.chunks,
-                         "ppl": result.ppl().tolist(), "wall_s": result.wall_s})
-    return result
+    return _run_accumulator_sweep(
+        result, token_ids, max_length=max_length, stride=stride,
+        window_batch=window_batch, submit=submit, accumulate=accumulate,
+        checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        metrics_path=metrics_path, max_chunks=max_chunks)
 
 
 def run_channel_sweep(
@@ -714,46 +721,22 @@ def run_channel_sweep(
     result = SweepResult(
         axes={"methods": list(methods), "layers_of_interest": list(layers_of_interest)},
         total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="token_weighted")
-    start_chunk = 0
-    if (state := _load_checkpoint(checkpoint_path, result.axes)) is not None:
-        result.total_nll = np.asarray(state["total_nll"])
-        result.n_tokens, result.chunks = state["n_tokens"], state["chunks"]
-        start_chunk = state["next_chunk"]
 
     fwd = _plain_forward(cfg)
-    t0 = time.monotonic()
-    next_chunk = start_chunk
-    last_ckpt = result.chunks
-    remaining = None if max_chunks is None else max_chunks - result.chunks
-    def submit_group(group):
-        ids, targets, counts, tail = _group_arrays(group)
-        hiddens = fwd(params, ids)  # (L, W, S, D)
-        pending = [(m, l, _suffix_channel(cfg, int(layer), method, tail)(
-                       params, hiddens[layer], targets))  # (W,)
-                   for m, method in enumerate(methods)
-                   for l, layer in enumerate(layers_of_interest)]
-        return group, counts, pending
 
-    def drain_group(rec):
-        nonlocal next_chunk, last_ckpt
-        group, counts, pending = rec
+    def submit(ids, targets, tail):
+        hiddens = fwd(params, ids)  # (L, W, S, D)
+        return [(m, l, _suffix_channel(cfg, int(layer), method, tail)(
+                    params, hiddens[layer], targets))  # (W,)
+                for m, method in enumerate(methods)
+                for l, layer in enumerate(layers_of_interest)]
+
+    def accumulate(pending, counts):
         for m, l, nlls in pending:
             result.total_nll[m, l] += np.asarray(nlls, np.float64) @ counts
-        result.n_tokens += counts.sum()
-        result.chunks += len(group)
-        next_chunk = group[-1].index + 1
-        if result.chunks - last_ckpt >= checkpoint_every:
-            last_ckpt = result.chunks
-            _save_checkpoint(checkpoint_path, result, next_chunk)
-            _emit(metrics_path, {"chunk": group[-1].index, "ppl": result.ppl().tolist()})
 
-    _run_pipelined(
-        _iter_window_groups(token_ids, max_length, stride,
-                            window_batch=window_batch, start_chunk=start_chunk,
-                            max_count=remaining, tail_of=_scoring_tail),
-        submit_group, drain_group)
-    result.wall_s = time.monotonic() - t0
-    _save_checkpoint(checkpoint_path, result, next_chunk)
-    _emit(metrics_path, {"final": True, "chunks": result.chunks,
-                         "ppl": result.ppl().tolist(), "wall_s": result.wall_s})
-    return result
+    return _run_accumulator_sweep(
+        result, token_ids, max_length=max_length, stride=stride,
+        window_batch=window_batch, submit=submit, accumulate=accumulate,
+        checkpoint_path=checkpoint_path, checkpoint_every=checkpoint_every,
+        metrics_path=metrics_path, max_chunks=max_chunks)

@@ -118,6 +118,38 @@ def test_checkpoint_resume_is_exact(setup, tmp_path):
     np.testing.assert_allclose(resumed.ppl(), full.ppl(), rtol=1e-6)
 
 
+@pytest.mark.parametrize("driver", ["initial", "channel"])
+def test_repeated_kill_resume_all_drivers(setup, tmp_path, driver):
+    """The unified scaffold gives initial/channel sweeps the same exact resume
+    as the token sweep: kill after every chunk, resume until done, totals match
+    the uninterrupted run bit-for-bit."""
+    params, corpus = setup
+    if driver == "initial":
+        def run(**extra):
+            return run_initial_sweep(
+                CFG, params, corpus, layers_of_interest=[1, "upto ratio"],
+                ratios=[0, 5], max_length=48, stride=24, quant_layer=1, **extra)
+    else:
+        def run(**extra):
+            return run_channel_sweep(
+                CFG, params, corpus, methods=["channel_8", "channel_1_mean"],
+                layers_of_interest=[2], max_length=48, stride=24, **extra)
+
+    full = run()
+    ckpt = str(tmp_path / "ckpt.json")
+    out = run(checkpoint_path=ckpt, checkpoint_every=1, max_chunks=1)
+    for _ in range(full.chunks * 2):  # one chunk per "crash"
+        if out.chunks >= full.chunks:
+            break
+        out = run(checkpoint_path=ckpt, checkpoint_every=1,
+                  max_chunks=out.chunks + 1)
+    resumed = run(checkpoint_path=ckpt, checkpoint_every=1)
+    assert resumed.chunks == full.chunks
+    np.testing.assert_allclose(resumed.total_nll, full.total_nll, rtol=1e-6)
+    # the cumulative wall clock survives resumes (monotone, not reset)
+    assert resumed.wall_s >= out.wall_s
+
+
 def test_channel_sweep_equals_full_boundary_forward(setup):
     params, corpus = setup
     methods, layers = ["channel_4", "channel_1_max"], [2]
