@@ -1,11 +1,18 @@
 """Slice-aware multi-host mesh layout (parallel/distributed.py).
 
-Multi-process can't run in this environment, so the grid-building logic is
-unit-tested against mocked device lists carrying slice/process metadata, and
-the mesh builders are integration-tested on the spoofed single-slice CPU
-devices (where they must agree with the plain builders).
+The grid-building logic is unit-tested against mocked device lists carrying
+slice/process metadata; the mesh builders are integration-tested on the
+spoofed single-slice CPU devices (where they must agree with the plain
+builders); and the multi-process path is EXECUTED for real by
+``test_two_process_split_eval_matches_single_process``: two subprocesses join
+a localhost coordinator (gloo CPU collectives), shard the split eval's data
+axis across processes, and must reproduce the single-process PPL exactly —
+including a kill-and-resume through the shared checkpoint.
 """
 import dataclasses
+import json
+import os
+import sys
 
 import numpy as np
 import pytest
@@ -230,3 +237,81 @@ def test_runtime_error_coordinator_also_degrades(monkeypatch):
                             "failed to connect to coordinator at 10.0.0.2:1234")))
     with pytest.raises(RuntimeError, match="failed to connect"):
         dist.initialize_distributed()
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn_workers(out_dir, max_chunks=None, nprocs=2):
+    """Launch one multiproc_worker.py per rank against a fresh localhost
+    coordinator; returns the per-rank CompletedProcess list."""
+    import subprocess
+
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(__file__))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    args = lambda r: [sys.executable, worker, str(r), str(nprocs), str(port),
+                      str(out_dir)] + ([str(max_chunks)] if max_chunks else [])
+    procs = [subprocess.Popen(args(r), env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(nprocs)]
+    done = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            done.append((p.returncode, out))
+    finally:
+        for p in procs:  # never orphan the peer when one rank hangs/dies
+            if p.poll() is None:
+                p.kill()
+    for rc, out in done:
+        assert rc == 0, f"worker failed (rc={rc}):\n{out[-4000:]}"
+    return done
+
+
+def test_two_process_split_eval_matches_single_process(tmp_path):
+    """The multi-process (DCN) path, EXECUTED: 2 subprocesses, localhost
+    coordinator, gloo CPU collectives, the split eval's data axis spanning
+    processes. Covers fetch_global's process_allgather branch and the
+    process-0-only checkpoint/metrics writes — the final PPL must equal a
+    single-process run, through a kill-and-resume."""
+    from edgellm_tpu.models import tiny_config, init_params
+    from edgellm_tpu.eval.split_eval import run_split_eval
+
+    # phase 1: stop after 2 chunks ("kill"); phase 2: resume to completion
+    _spawn_workers(tmp_path, max_chunks=2)
+    ckpt = json.load(open(tmp_path / "ckpt.json"))
+    assert ckpt["chunks"] == 2
+    _spawn_workers(tmp_path)
+
+    results = [json.load(open(tmp_path / f"result_{r}.json")) for r in (0, 1)]
+    # SPMD: every rank holds identical accumulators
+    assert results[0]["ppl"] == results[1]["ppl"]
+    assert results[0]["chunks"] == results[1]["chunks"]
+
+    # single-process oracle on this process's spoofed devices (same math, no
+    # process boundary); the workload definition is shared with the worker
+    from multiproc_worker import workload
+
+    cfg_kwargs, (seed, length), run_kwargs = workload()
+    cfg = tiny_config("qwen2", **cfg_kwargs)
+    params = init_params(cfg, jax.random.key(0))
+    corpus = np.random.default_rng(seed).integers(0, cfg.vocab_size, length)
+    single = run_split_eval(cfg, params, corpus, window_batch=2, **run_kwargs)
+    assert results[0]["chunks"] == single["chunks"]
+    np.testing.assert_allclose(results[0]["ppl"], single["ppl"],
+                               rtol=1e-5, atol=1e-6)
+    # process-0-only writes: checkpoint + metrics exist and are consistent
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    finals = [rec for rec in lines if rec.get("final")]
+    np.testing.assert_allclose(finals[-1]["ppl"], single["ppl"],
+                               rtol=1e-5, atol=1e-6)
