@@ -222,8 +222,19 @@ def _jnp_unpack_dequant(packed: jnp.ndarray, safe: jnp.ndarray) -> jnp.ndarray:
     return unpack_int4(packed).astype(jnp.float32) / 7.0 * safe
 
 
+def _local_selective_scale(low, nonempty: bool, per_row: bool):
+    """Default int4 scale for the selective codec: max|low| with the zero /
+    empty-k guard. ``nonempty`` is the static ``k > 0``."""
+    if per_row:
+        mx = (jnp.max(jnp.abs(low), axis=(1, 2)) if nonempty
+              else jnp.zeros((low.shape[0],), jnp.float32))
+    else:
+        mx = jnp.max(jnp.abs(low)) if nonempty else jnp.asarray(0.0)
+    return jnp.where(mx > 0, mx, 1.0)
+
+
 def selective_int4(ratio: float, high: str = "bf16", *,
-                   quant_pack=None, unpack_dequant=None,
+                   quant_pack=None, unpack_dequant=None, scale_fn=None,
                    name_suffix: str = "") -> WireCodec:
     """Token-selective mixed-precision boundary codec (BASELINE.json configs[2]).
 
@@ -253,12 +264,15 @@ def selective_int4(ratio: float, high: str = "bf16", *,
     int4 compute core (the Pallas wrapper passes its fused kernels; the wire
     format and all selection/reassembly logic stay in this one definition).
     ``scale`` arrives as a scalar (shared path) or (B, 1, 1) (per-row path).
+    ``scale_fn(low, nonempty, per_row)`` overrides the scale reduction (the
+    ring-sharded local mode passes a ``pmax``-agreed global scale).
     """
     if not 0.0 <= ratio <= 1.0:
         raise ValueError(f"ratio must be in [0, 1], got {ratio}")
     high_dtype = {"fp32": jnp.float32, "bf16": jnp.bfloat16, "fp16": jnp.float16}[high]
     quant_pack = quant_pack or _jnp_quant_pack
     unpack_dequant = unpack_dequant or _jnp_unpack_dequant
+    scale_fn = scale_fn or _local_selective_scale
 
     def encode(h, importance):
         b, s, d = h.shape
@@ -271,9 +285,7 @@ def selective_int4(ratio: float, high: str = "bf16", *,
             order = jnp.argsort(importance, axis=-1)  # (B, S), ascending
             rows = jnp.arange(b)[:, None]
             low = h[rows, order[:, :k]]  # (B, k, D)
-            max_val = (jnp.max(jnp.abs(low), axis=(1, 2)) if k
-                       else jnp.zeros((b,), jnp.float32))
-            safe = jnp.where(max_val > 0, max_val, 1.0)  # (B,)
+            safe = scale_fn(low, k > 0, True)  # (B,)
             # high tokens ship position-ascending: their placement is implied
             # by the low-index set, so only the k low indices cross the wire
             high_pos = jnp.sort(order[:, k:], axis=-1)
@@ -288,8 +300,7 @@ def selective_int4(ratio: float, high: str = "bf16", *,
         low_idx = order[:k]
         high_pos = jnp.sort(order[k:])  # position-ascending (see per-row note)
         low = jnp.take(h, low_idx, axis=1)  # (B, k, D)
-        max_val = jnp.max(jnp.abs(low)) if k else jnp.asarray(0.0)
-        safe = jnp.where(max_val > 0, max_val, 1.0)
+        safe = scale_fn(low, k > 0, False)
         return {
             "low": quant_pack(low, safe) if k else jnp.zeros((b, 0, d // 2), jnp.uint8),
             "scale": safe[None],

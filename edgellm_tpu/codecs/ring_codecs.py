@@ -1,0 +1,194 @@
+"""Sequence-sharded (ring) variants of the token-selective boundary codec.
+
+The reference's headline scheme — quantize the ``ratio`` least-important tokens
+of the boundary activation to symmetric int4 with one global scale
+(``/root/reference/Experiments/Qwen2-0.5B/qwen_layer_wise.py:54-73``) — selects
+tokens by a GLOBAL argsort of the importance vector. Under the stage x seq
+runtime no device holds the full sequence, so the selection and the scale must
+be agreed across sequence shards. Two variants, both running INSIDE
+``shard_map`` on the ring axis:
+
+- ``mode="global"`` — exact reference semantics. The (B, S) importance vector
+  (a scalar per token — tiny next to the (B, S, D) activation) is
+  ``all_gather``-ed over the ring axis so every shard computes the SAME stable
+  argsort as the dense codec; the int4 scale is the ``pmax`` of the per-shard
+  maxima over selected tokens (exactly the global max). Decoded values are
+  bit-identical to the dense ``selective_int4`` codec given the same
+  importance. The wire price of exactness: the number of selected tokens per
+  shard is data-dependent, so the low buffer is capacity-padded to
+  ``min(S_loc, k)`` and the high tokens ship IN PLACE (a full ``S_loc``-token
+  buffer) — per-token bytes are ``high + c_low/S_loc * (D/2 + 2)``, i.e.
+  MORE than an all-``high`` hop. Use it when reference parity matters more
+  than wire bytes (it is the parity oracle for the local mode).
+
+- ``mode="local"`` — the wire-optimal scalable variant. Each shard selects its
+  own ``int(ratio * S_loc)`` least-important LOCAL tokens (same compression
+  ratio, shard-local ordering) while the int4 scale is still agreed globally
+  via ``pmax`` so all shards quantize on one grid. Static per-shard payload
+  sizes equal the dense codec's per-token bytes exactly; the selected SET may
+  differ from the dense global argsort (it is the per-shard restriction of a
+  rank-balanced selection), so PPL is close to but not bit-equal with the
+  dense path.
+
+Both accept shared ``(S_loc,)`` or per-row ``(B, S_loc)`` LOCAL importance
+shards, mirroring the dense codec's wire format rules.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from .packing import (WireCodec, _jnp_quant_pack, _jnp_unpack_dequant,
+                      selective_int4)
+
+
+@dataclasses.dataclass(frozen=True)
+class RingWireCodec(WireCodec):
+    """A wire codec whose encode/decode run inside ``shard_map`` on
+    ``ring_axis`` and move one LOCAL sequence shard per device. Collectives
+    inside ``encode`` make ``jax.eval_shape``-based byte accounting impossible
+    outside the mesh, so payload bytes are computed analytically (verified
+    against the in-mesh buffers in ``tests/test_ring_codecs.py``)."""
+
+    ring_axis: str = "seq"
+    n_seq: int = 1
+    #: (full_hidden_shape, dtype) -> total payload bytes across all shards
+    payload_bytes_fn: object = None
+
+    def payload_bytes(self, hidden_shape, dtype=jnp.float32) -> int:
+        return int(self.payload_bytes_fn(hidden_shape))
+
+
+_HIGH_DTYPES = {"fp32": jnp.float32, "bf16": jnp.bfloat16, "fp16": jnp.float16}
+
+
+def _global_scale(low, k_known_nonempty, axis_name, per_row):
+    """max|selected| on this shard -> pmax over the ring = the global max,
+    with the dense codec's zero/empty guard applied AFTER the reduction."""
+    if per_row:
+        local = jnp.max(jnp.abs(low), axis=(1, 2)) if k_known_nonempty \
+            else jnp.zeros((low.shape[0],), jnp.float32)
+    else:
+        local = jnp.max(jnp.abs(low)) if k_known_nonempty else jnp.asarray(0.0)
+    mx = jax.lax.pmax(local, axis_name)
+    return jnp.where(mx > 0, mx, 1.0)
+
+
+def ring_selective_int4(ratio: float, high: str = "bf16", *, n_seq: int,
+                        axis_name: str = "seq",
+                        mode: str = "global") -> RingWireCodec:
+    """Build the ring-sharded token-selective codec (see module docstring)."""
+    if not 0.0 <= ratio <= 1.0:
+        raise ValueError(f"ratio must be in [0, 1], got {ratio}")
+    if mode not in ("global", "local"):
+        raise ValueError(f"mode must be 'global' or 'local', got {mode!r}")
+    if n_seq < 1:
+        raise ValueError(f"n_seq must be >= 1, got {n_seq}")
+    high_dtype = _HIGH_DTYPES[high]
+    high_bytes = jnp.dtype(high_dtype).itemsize
+
+    # ---------- mode="global": exact dense selection ----------
+
+    def encode_global(h_loc, imp_loc):
+        b, s_loc, d = h_loc.shape
+        s = s_loc * n_seq
+        if s > 32767:
+            raise ValueError(f"selective_int4 int16 side channel needs "
+                             f"S <= 32767, got {s}")
+        k = int(ratio * s)  # static, same float64 truncation as dense
+        c_low = min(s_loc, k)
+        idx = jax.lax.axis_index(axis_name)
+        per_row = jnp.ndim(imp_loc) == 2
+        # the small collective: gather the per-token importance scalars and
+        # run the SAME stable argsort the dense codec runs -> identical set
+        imp_full = jax.lax.all_gather(imp_loc, axis_name, axis=-1, tiled=True)
+        order = jnp.argsort(imp_full, axis=-1)  # (S,) or (B, S), ascending
+        low_global = order[..., :k]  # global positions of the selected tokens
+        # membership mask for THIS shard's positions [idx*s_loc, (idx+1)*s_loc)
+        full_mask = jnp.zeros(imp_full.shape, bool)
+        if per_row:
+            rows = jnp.arange(b)[:, None]
+            full_mask = full_mask.at[rows, low_global].set(k > 0)
+            mask_loc = jax.lax.dynamic_slice_in_dim(
+                full_mask, idx * s_loc, s_loc, axis=1)  # (B, S_loc)
+            # compacted local low positions; empty slots point past the shard
+            low_idx = jax.vmap(
+                lambda m: jnp.nonzero(m, size=c_low, fill_value=s_loc)[0])(
+                    mask_loc)  # (B, c_low)
+            take = jnp.minimum(low_idx, s_loc - 1)
+            low = jnp.where((low_idx < s_loc)[..., None],
+                            h_loc[rows, take], 0.0)  # (B, c_low, D)
+            safe = _global_scale(low, k > 0, axis_name, True)  # (B,)
+            packed = (_jnp_quant_pack(low, safe[:, None, None]) if c_low
+                      else jnp.zeros((b, 0, d // 2), jnp.uint8))
+            return {"low": packed, "scale": safe,
+                    "high": h_loc.astype(high_dtype),  # in place; low slots
+                    "idx": low_idx.astype(jnp.int16)}  # overwritten on decode
+        full_mask = full_mask.at[low_global].set(k > 0)
+        mask_loc = jax.lax.dynamic_slice_in_dim(full_mask, idx * s_loc, s_loc, 0)
+        low_idx = jnp.nonzero(mask_loc, size=c_low, fill_value=s_loc)[0]
+        take = jnp.minimum(low_idx, s_loc - 1)
+        low = jnp.where((low_idx < s_loc)[None, :, None],
+                        jnp.take(h_loc, take, axis=1), 0.0)  # (B, c_low, D)
+        safe = _global_scale(low, k > 0, axis_name, False)
+        packed = (_jnp_quant_pack(low, safe) if c_low
+                  else jnp.zeros((b, 0, d // 2), jnp.uint8))
+        return {"low": packed, "scale": safe[None],
+                "high": h_loc.astype(high_dtype),
+                "idx": low_idx.astype(jnp.int16)}
+
+    def decode_global(p):
+        out = p["high"].astype(jnp.float32)  # (B, S_loc, D)
+        b, s_loc, d = out.shape
+        c_low = p["low"].shape[1]
+        if not c_low:
+            return out
+        if p["scale"].ndim == 1 and p["scale"].shape[0] == b and p["idx"].ndim == 2:
+            low = _jnp_unpack_dequant(p["low"], p["scale"][:, None, None])
+            rows = jnp.arange(b)[:, None]
+            # empty capacity slots carry index s_loc -> dropped by the scatter
+            return out.at[rows, p["idx"].astype(jnp.int32)].set(
+                low, mode="drop")
+        low = _jnp_unpack_dequant(p["low"], p["scale"][0])
+        return out.at[:, p["idx"].astype(jnp.int32)].set(low, mode="drop")
+
+    # ---------- mode="local": shard-local selection, global scale ----------
+    # the dense codec applied to each shard (its encode sees the LOCAL
+    # sequence, so k becomes int(ratio * S_loc) automatically), with only the
+    # scale reduction swapped for the ring-agreed pmax — one wire-format
+    # definition, no drift
+
+    def ring_scale(low, nonempty, per_row):
+        return _global_scale(low, nonempty, axis_name, per_row)
+
+    local_base = selective_int4(ratio, high, scale_fn=ring_scale)
+
+    def payload_bytes_fn(hidden_shape):
+        """Total bytes across all n_seq shard payloads for one full (B, S, D)
+        boundary activation (what actually crosses the stage hop)."""
+        b, s, d = hidden_shape
+        s_loc = s // n_seq
+        if mode == "global":
+            k = int(ratio * s)
+            c_low = min(s_loc, k)
+            per_shard = (b * c_low * (d // 2)       # packed int4 capacity
+                         + b * s_loc * d * high_bytes  # in-place high buffer
+                         + b * c_low * 2            # int16 local indices
+                         + b * 4)                   # per-row fp32 scale
+        else:
+            k_loc = int(ratio * s_loc)
+            per_shard = (b * k_loc * (d // 2)
+                         + b * (s_loc - k_loc) * d * high_bytes
+                         + b * k_loc * 2
+                         + b * 4)
+        return n_seq * per_shard
+
+    enc = encode_global if mode == "global" else local_base.encode
+    dec = decode_global if mode == "global" else local_base.decode
+    return RingWireCodec(
+        name=f"ring_selective_int4_r{ratio}_{high}_{mode}",
+        encode=enc, decode=dec,
+        batch_invariant=False, needs_importance=True,
+        ring_axis=axis_name, n_seq=n_seq, payload_bytes_fn=payload_bytes_fn)

@@ -35,19 +35,39 @@ from .harness import (ResumableDriver, _emit, _iter_window_groups,
                       _run_pipelined, fetch_global)
 
 
-def parse_hop_codec(spec: str) -> object:
+def parse_hop_codec(spec: str, n_seq: int = 1) -> object:
     """Codec spec -> registry name or WireCodec.
 
     Plain names pass through (``"int4_per_token"``, ``"int8_per_token_pallas"``);
-    token-selective specs use ``"selective_int4:<ratio>[:<high>]"`` (e.g.
-    ``"selective_int4:0.25:bf16"``) or ``"selective_int4_pallas:..."`` to pin
-    the fused-kernel implementation explicitly.
+    token-selective specs use ``"selective_int4:<ratio>[:<high>][:<mode>]"``
+    (e.g. ``"selective_int4:0.25:bf16"``) or ``"selective_int4_pallas:..."``
+    to pin the fused-kernel implementation explicitly.
+
+    With ``n_seq > 1`` (the stage x seq runtime) selective specs resolve to the
+    ring-sharded variant (``codecs.ring_codecs.ring_selective_int4``):
+    ``mode`` picks ``"global"`` (exact dense selection via an importance
+    all_gather — the default) or ``"local"`` (wire-optimal shard-local
+    selection, globally agreed scale).
     """
     if not spec.startswith("selective_int4"):
         return spec
     parts = spec.split(":")
     ratio = float(parts[1]) if len(parts) > 1 else 0.25
     high = parts[2] if len(parts) > 2 else "bf16"
+    mode = parts[3] if len(parts) > 3 else "global"
+    if n_seq > 1:
+        if parts[0].endswith("_pallas"):
+            # no fused ring variant exists; silently substituting the jnp ring
+            # codec would discard the user's explicit kernel pin
+            raise ValueError(
+                f"{parts[0]!r} has no ring (n_seq > 1) implementation; use "
+                f"'selective_int4:...' and let the backend choose")
+        from ..codecs.ring_codecs import ring_selective_int4
+
+        return ring_selective_int4(ratio, high, n_seq=n_seq, mode=mode)
+    if len(parts) > 3:
+        raise ValueError(f"selective mode {mode!r} only applies to the "
+                         f"stage x seq runtime (n_seq > 1)")
     if parts[0].endswith("_pallas"):
         from ..codecs.pallas_kernels import pallas_selective_int4
 
@@ -110,7 +130,8 @@ def run_split_eval(
     axis size with repeated windows whose loss weight is zero (the padding does
     cross the wire and is counted in the pushed-token/byte totals).
     """
-    codecs = [parse_hop_codec(c) if isinstance(c, str) else c for c in hop_codecs]
+    codecs = [parse_hop_codec(c, n_seq) if isinstance(c, str) else c
+              for c in hop_codecs]
     split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(codecs))
     if n_seq > 1:
         from ..parallel.ring import SplitRingRuntime, make_sp_stage_mesh
@@ -126,9 +147,20 @@ def run_split_eval(
     needs_imp = [c.needs_importance for c in rt.codecs]
     if any(needs_imp) and importance_method is None:
         raise ValueError("token-selective hop codecs require importance_method")
-    # only pay the stats forward when some hop actually consumes importance
-    imp_fn = (_importance_fn(cfg, importance_method)
-              if any(needs_imp) and importance_method is not None else None)
+    # only pay the stats forward when some hop actually consumes importance;
+    # under the stage x seq runtime the stats come from the ring rotation
+    # itself (importance_sp) — no device ever holds the full sequence
+    if any(needs_imp) and importance_method is not None:
+        if n_seq > 1:
+            from ..parallel.ring import importance_sp
+
+            def imp_fn(params_, ids_, hw_):
+                return importance_sp(cfg, params_, ids_, mesh,
+                                     importance_method, head_weights=hw_)
+        else:
+            imp_fn = _importance_fn(cfg, importance_method)
+    else:
+        imp_fn = None
     hw = None if head_weights is None else jnp.asarray(head_weights)
     n_data = dict(mesh.shape).get("data", 1)
     if window_batch % n_data:

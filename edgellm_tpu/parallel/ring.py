@@ -45,7 +45,7 @@ def make_seq_mesh(n_seq: int, devices=None) -> Mesh:
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str = "seq") -> jnp.ndarray:
+                   axis_name: str = "seq", capture_stats: bool = False):
     """Causal ring attention over locally-sharded (B, S_loc, H, hd) query blocks.
 
     Must run inside ``shard_map`` with the sequence sharded on ``axis_name``.
@@ -59,6 +59,20 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     statically unrolled (n is a trace-time constant), so XLA can overlap each
     hop's ppermute with the previous block's matmuls, and the last iteration
     sends nothing.
+
+    ``capture_stats``: also return the reduced attention statistics the
+    importance metrics consume (``AttnStats`` semantics, but sequence-sharded:
+    each device ends holding the (B, H, S_loc) slice for ITS key block) —
+    ``(col_sum / S, last_row)``. The column sums are accumulated during a
+    second K rotation: exact per-key probabilities need the FINAL softmax max
+    and denominator of every query row, which only exist after the first full
+    rotation (a running column sum cannot be corrected retroactively — the
+    per-query corrections collapse when summed over queries). The stats
+    accumulators travel WITH the circulating K block and arrive back at its
+    home device after n hops; the extra pass reuses the pass-1 scores math but
+    skips the value matmul (~half an attention pass, only when stats are
+    requested). Returns ``(out, (col_sum/S, last_row))`` with stats on,
+    plain ``out`` otherwise (a bare array composes with shard_map out_specs).
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -73,15 +87,18 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     k_blk, v_blk = k, v
     ring = [(i, (i + 1) % n) for i in range(n)]
 
-    for t in range(n):
-        src = (idx - t) % n  # which global block this K/V is
+    def scores_for(k_blk, src):
         k_pos = src * s_loc + jnp.arange(s_loc)
         k_t = jnp.repeat(k_blk, rep, axis=2) if rep > 1 else k_blk
-        v_t = jnp.repeat(v_blk, rep, axis=2) if rep > 1 else v_blk
         scores = jnp.einsum("bshd,bthd->bhst", q, k_t,
                             preferred_element_type=jnp.float32) * scale
         mask = q_pos[:, None] >= k_pos[None, :]  # global causal
-        scores = jnp.where(mask[None, None], scores, NEG_INF)
+        return jnp.where(mask[None, None], scores, NEG_INF), mask
+
+    for t in range(n):
+        src = (idx - t) % n  # which global block this K/V is
+        scores, mask = scores_for(k_blk, src)
+        v_t = jnp.repeat(v_blk, rep, axis=2) if rep > 1 else v_blk
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
         p = jnp.exp(scores - m_new[..., None]) * mask[None, None]
         correction = jnp.exp(m - m_new)
@@ -95,10 +112,36 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, H, S_loc, hd)
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    if not capture_stats:
+        return out
+
+    # second rotation: exact probabilities from the now-final (m, l); the
+    # (B, H, S_loc) column-sum / last-row accumulators ride the ring with
+    # their K block and land home after n hops
+    l_safe = jnp.maximum(l, 1e-30)
+    k_blk = k
+    col_acc = jnp.zeros((b, h, s_loc), jnp.float32)
+    last_acc = jnp.zeros((b, h, s_loc), jnp.float32)
+    is_last = (idx == n - 1)  # device holding the globally-last query row
+    for t in range(n):
+        src = (idx - t) % n
+        scores, mask = scores_for(k_blk, src)
+        probs = jnp.exp(scores - m[..., None]) * mask[None, None] \
+            / l_safe[..., None]  # (B, H, S_loc_q, S_loc_k), exact
+        col_acc = col_acc + jnp.sum(probs, axis=2)
+        last_acc = last_acc + jnp.where(is_last, probs[:, :, -1, :], 0.0)
+        # permute on EVERY step (unlike pass 1) so block and accumulators
+        # complete the full circle back to the block's home device
+        k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+        col_acc = jax.lax.ppermute(col_acc, axis_name, ring)
+        last_acc = jax.lax.ppermute(last_acc, axis_name, ring)
+    s_total = n * s_loc
+    return out, (col_acc / s_total, last_acc)
 
 
-def _sp_attention(cfg: ModelConfig, lp: dict, x, cos_loc, sin_loc, axis_name):
+def _sp_attention(cfg: ModelConfig, lp: dict, x, cos_loc, sin_loc, axis_name,
+                  capture_stats: bool = False):
     """Per-layer attention with ring communication; x is (B, S_loc, D)."""
     b, s_loc, d = x.shape
     h, kv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -113,24 +156,32 @@ def _sp_attention(cfg: ModelConfig, lp: dict, x, cos_loc, sin_loc, axis_name):
     k = apply_rotary(k, cos_loc, sin_loc, cfg.rotary_dim)
     # GQA: the unexpanded KV-head blocks circulate the ring; ring_attention
     # broadcasts heads locally per step
-    out = ring_attention(q, k, v, axis_name)
+    if capture_stats:
+        out, stats = ring_attention(q, k, v, axis_name, capture_stats=True)
+    else:
+        out, stats = ring_attention(q, k, v, axis_name), None
     out = out.reshape(b, s_loc, h * hd) @ lp["wo"]
     if "bo" in lp:
         out = out + lp["bo"]
-    return out
+    return out, stats
 
 
-def _sp_block(cfg: ModelConfig, lp: dict, hidden, cos_loc, sin_loc, axis_name):
-    """Decoder block with ring attention; norms/MLP are per-token (trivially SP)."""
+def _sp_block(cfg: ModelConfig, lp: dict, hidden, cos_loc, sin_loc, axis_name,
+              capture_stats: bool = False):
+    """Decoder block with ring attention; norms/MLP are per-token (trivially SP).
+    Returns ``(hidden, stats)`` — stats None unless ``capture_stats``."""
     if cfg.family == "gpt_neox":
         attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
-        attn_out = _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc, axis_name)
+        attn_out, stats = _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc,
+                                        axis_name, capture_stats)
         mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
-        return hidden + attn_out + mlp(cfg, lp, mlp_in)
+        return hidden + attn_out + mlp(cfg, lp, mlp_in), stats
     attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
-    hidden = hidden + _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc, axis_name)
+    attn_out, stats = _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc,
+                                    axis_name, capture_stats)
+    hidden = hidden + attn_out
     mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
-    return hidden + mlp(cfg, lp, mlp_in)
+    return hidden + mlp(cfg, lp, mlp_in), stats
 
 
 @functools.lru_cache(maxsize=None)
@@ -147,7 +198,8 @@ def _sp_forward(cfg: ModelConfig, mesh: Mesh, axis_name: str):
             hidden = embed(params, ids_loc)  # already ring-varying via ids_loc
 
             def scan_body(h, lp):
-                return _sp_block(cfg, lp, h, cos_loc, sin_loc, axis_name), None
+                out, _ = _sp_block(cfg, lp, h, cos_loc, sin_loc, axis_name)
+                return out, None
 
             hidden, _ = jax.lax.scan(scan_body, hidden, params["layers"])
             return unembed(cfg, params, hidden)
@@ -167,6 +219,64 @@ def forward_sp(cfg: ModelConfig, params, input_ids, mesh: Mesh,
     full fp32 logits. Weights replicated, activations 1/n per device, attention
     via the K/V ring."""
     return _sp_forward(cfg, mesh, axis_name)(params, jnp.asarray(input_ids))
+
+
+@functools.lru_cache(maxsize=None)
+def _sp_importance(cfg: ModelConfig, mesh: Mesh, method: str, axis_name: str):
+    from ..models.transformer import AttnStats
+    from ..importance import importance_per_layer
+
+    @jax.jit
+    def fn(params, input_ids, head_weights):
+        seq = input_ids.shape[1]
+        if seq % mesh.shape[axis_name]:
+            raise ValueError(f"sequence length {seq} not divisible by "
+                             f"{axis_name} axis size {mesh.shape[axis_name]}")
+        cos, sin = precompute_rope(cfg, seq)
+
+        def body(params, hw, ids_loc, cos_loc, sin_loc):
+            hidden = embed(params, ids_loc)
+
+            def scan_body(h, lp):
+                out, stats = _sp_block(cfg, lp, h, cos_loc, sin_loc, axis_name,
+                                       capture_stats=True)
+                return out, stats
+
+            _, (col, last) = jax.lax.scan(scan_body, hidden, params["layers"])
+            stats = AttnStats(col_mean=col, last_row=last)  # (L, B, H, S_loc)
+            # every metric is per-token over reduced stats, so the local
+            # shard's importance slice is computable entirely locally
+            return importance_per_layer(stats, method, hw)  # (L, B, S_loc)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(), P(None, axis_name), P(axis_name), P(axis_name)),
+            out_specs=P(None, None, axis_name),
+        )(params, head_weights, input_ids, cos, sin)
+
+    return fn
+
+
+def importance_sp(cfg: ModelConfig, params, input_ids, mesh: Mesh,
+                  method: str, head_weights=None,
+                  axis_name: str = "seq") -> jnp.ndarray:
+    """Sequence-parallel importance: the (L, B, S) scores of
+    ``importance_per_layer``, computed WITHOUT any device ever holding the
+    full sequence — the attention statistics (column sums, last query row) are
+    accumulated inside ``ring_attention``'s K rotation and stay sequence-
+    sharded; so does the returned importance (a global array sharded on S).
+
+    This is the long-context replacement for the dense stats forward the
+    simulate harness uses (``eval/harness.py:_stats_forward``): same methods,
+    same values (up to flash-vs-dense softmax roundoff), no O(S^2) buffer and
+    no full-S activation anywhere.
+    """
+    if method == "weighted_importance" and head_weights is None:
+        raise ValueError("weighted_importance requires head_weights (L, H)")
+    hw = jnp.zeros((cfg.num_layers, cfg.num_heads), jnp.float32) \
+        if head_weights is None else jnp.asarray(head_weights)
+    return _sp_importance(cfg, mesh, method, axis_name)(
+        params, jnp.asarray(input_ids), hw)
 
 
 # ---------- stage x seq composition ----------
@@ -194,24 +304,31 @@ class SplitRingRuntime:
     the compressed payload — so long contexts never gather onto one device at
     the cut either.
 
-    Hop codecs must be per-token (``batch_invariant``): their scales reduce only
-    over the feature axis, so encoding a sequence shard locally is identical to
-    encoding the full sequence. Global/selective codecs would need a collective
-    over "seq" to agree on scales/ordering and are rejected.
+    Hop codecs must be per-token (``batch_invariant``) — their scales reduce
+    only over the feature axis, so encoding a sequence shard locally is
+    identical to encoding the full sequence — OR explicitly ring-aware
+    (:class:`~edgellm_tpu.codecs.ring_codecs.RingWireCodec`): the selective
+    mixed-precision codec runs under "seq" by agreeing on ordering and global
+    scale across shards with small collectives (an all_gather of the per-token
+    importance scalars + a pmax of the scale). Other batch/sequence-reducing
+    codecs are rejected.
     """
 
     def __init__(self, cfg: ModelConfig, cuts, hop_codecs, mesh: Mesh):
         from .split import SplitConfig, apply_default_codec_backend
+        from ..codecs.ring_codecs import RingWireCodec
 
         self.cfg = cfg
         self.mesh = mesh
         self.split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(hop_codecs))
         self.codecs = apply_default_codec_backend(list(self.split.hop_codecs))
-        bad = [c.name for c in self.codecs if not c.batch_invariant]
+        bad = [c.name for c in self.codecs
+               if not c.batch_invariant and not isinstance(c, RingWireCodec)]
         if bad:
             raise ValueError(
-                f"stage x seq hops need per-token codecs; {bad} reduce over "
-                f"batch/sequence and would disagree across sequence shards")
+                f"stage x seq hops need per-token or ring-aware codecs; {bad} "
+                f"reduce over batch/sequence and would disagree across "
+                f"sequence shards")
         missing = [a for a in ("stage", "seq") if a not in mesh.shape]
         if missing:
             raise ValueError(f"SplitRingRuntime needs a mesh with 'stage' and "
@@ -220,6 +337,13 @@ class SplitRingRuntime:
         if mesh.shape["stage"] != self.split.n_stages:
             raise ValueError(f"mesh has {mesh.shape['stage']} stages, split "
                              f"needs {self.split.n_stages}")
+        for c in self.codecs:
+            if isinstance(c, RingWireCodec) and (c.ring_axis != "seq"
+                                                 or c.n_seq != mesh.shape["seq"]):
+                raise ValueError(
+                    f"ring codec {c.name} was built for axis "
+                    f"{c.ring_axis!r} x{c.n_seq}, mesh has 'seq' "
+                    f"x{mesh.shape['seq']}")
         self.bounds = self.split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
         self._forward = self._build_forward()
@@ -249,14 +373,15 @@ class SplitRingRuntime:
         cfg, n_stages = self.cfg, self.split.n_stages
         codecs, mesh = self.codecs, self.mesh
 
-        def body(local_layers, local_valid, other, ids_loc, cos_loc, sin_loc):
+        def body(local_layers, local_valid, other, ids_loc, cos_loc, sin_loc,
+                 hop_imps):
             lv = {k: v[0] for k, v in local_layers.items()}
             valid = local_valid[0]
             hidden = embed(other, ids_loc)  # (B, S_loc, D), seq-sharded
 
             def scan_body(h, xs):
                 lp, ok = xs
-                out = _sp_block(cfg, lp, h, cos_loc, sin_loc, "seq")
+                out, _ = _sp_block(cfg, lp, h, cos_loc, sin_loc, "seq")
                 return jnp.where(ok, out, h), None
 
             def run_stage(h):
@@ -264,12 +389,15 @@ class SplitRingRuntime:
                 return computed
 
             # the shared hop protocol moves each device's local seq shard
-            # (per-token codecs, so shard-local encode == full-sequence encode)
-            hidden = run_pipeline_stages(n_stages, codecs, run_stage, hidden)
+            # (per-token codecs encode shard-locally == full-sequence encode;
+            # ring-aware selective codecs agree on ordering/scale via their
+            # own small collectives over "seq")
+            hidden = run_pipeline_stages(n_stages, codecs, run_stage, hidden,
+                                         hop_imps)
             return unembed(cfg, other, hidden)
 
         @jax.jit
-        def fn(placed, input_ids):
+        def fn(placed, input_ids, hop_imps):
             seq = input_ids.shape[1]
             if seq % mesh.shape["seq"]:
                 raise ValueError(f"sequence length {seq} not divisible by seq "
@@ -278,12 +406,17 @@ class SplitRingRuntime:
             other = {k: v for k, v in placed.items()
                      if k not in ("layers", "layers_valid")}
             lspecs = jax.tree_util.tree_map(lambda _: P("stage"), placed["layers"])
+            # importance shards ride the seq axis on the token dimension, like
+            # the hidden: (n_hops, B, S) or (n_hops, S)
+            imp_spec = P(None, None, "seq") if hop_imps.ndim == 3 else P(None, "seq")
             return shard_map(
                 body, mesh=mesh,
-                in_specs=(lspecs, P("stage"), P(), P(None, "seq"), P("seq"), P("seq")),
+                in_specs=(lspecs, P("stage"), P(), P(None, "seq"), P("seq"),
+                          P("seq"), imp_spec),
                 out_specs=P(None, "seq"),
                 check_vma=False,
-            )(placed["layers"], placed["layers_valid"], other, input_ids, cos, sin)
+            )(placed["layers"], placed["layers_valid"], other, input_ids,
+              cos, sin, hop_imps)
 
         return fn
 
@@ -311,7 +444,39 @@ class SplitRingRuntime:
         return measure_hop_times(self.mesh, self.codecs, self.cfg, batch, seq,
                                  iters=iters, hidden_spec=P(None, "seq"))
 
-    def forward(self, placed_params: dict, input_ids) -> jnp.ndarray:
+    def forward(self, placed_params: dict, input_ids,
+                hop_importance: Optional[list] = None) -> jnp.ndarray:
         """ids (B, S) -> full fp32 logits; layers stage-split, sequence
-        ring-sharded, boundary hops carry packed per-token payload shards."""
-        return self._forward(placed_params, jnp.asarray(input_ids))
+        ring-sharded, boundary hops carry packed per-token payload shards.
+
+        ``hop_importance``: one (S,) / (B, S) entry per hop for ring-aware
+        selective codecs (``needs_importance``); arrays may be global
+        seq-sharded outputs of :func:`importance_sp` — the runtime shards them
+        over "seq" alongside the hidden, and the codec's own collectives
+        reconstruct the global ordering."""
+        input_ids = jnp.asarray(input_ids)
+        batch, seq = input_ids.shape
+        n_hops = len(self.codecs)
+        imps = list(hop_importance) if hop_importance is not None \
+            else [None] * n_hops
+        if len(imps) != n_hops:
+            raise ValueError(f"expected {n_hops} hop_importance entries, "
+                             f"got {len(imps)}")
+        for c, imp in zip(self.codecs, imps):
+            if c.needs_importance and imp is None:
+                raise ValueError(f"hop codec {c.name} requires an importance "
+                                 f"vector")
+            if c.needs_importance and batch > 1 and (
+                    jnp.ndim(imp) != 2 or jnp.shape(imp)[0] != batch):
+                raise ValueError(
+                    f"hop codec {c.name} with batch {batch} needs per-row "
+                    f"({batch}, S) importance (got shape {jnp.shape(imp)})")
+        per_row = any(i is not None and jnp.ndim(i) == 2 for i in imps) or (
+            batch > 1 and any(c.needs_importance for c in self.codecs))
+        blank = jnp.zeros((batch, seq) if per_row else (seq,), jnp.float32)
+        stacked = (jnp.zeros((0,) + blank.shape, jnp.float32) if not imps else
+                   jnp.stack([blank if i is None
+                              else jnp.broadcast_to(jnp.asarray(i, jnp.float32),
+                                                    blank.shape)
+                              for i in imps]))
+        return self._forward(placed_params, input_ids, stacked)

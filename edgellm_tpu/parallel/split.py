@@ -136,20 +136,19 @@ def measure_hop_times(mesh, codecs, cfg, batch: int, seq: int, *,
     # (B x S order side channel), so time that payload, not the shared one
     imp = (jnp.arange(seq, dtype=jnp.float32) if batch == 1 else
            jnp.broadcast_to(jnp.arange(seq, dtype=jnp.float32), (batch, seq)))
+    # the probe importance shards over the token axis exactly like the hidden:
+    # hidden_spec's axes are (batch, tokens[, features]), so the token entry
+    # is hidden_spec[1] (None for the replicated plain-split probe, "seq" for
+    # the stage x seq probe)
+    token_axis = hidden_spec[1] if len(hidden_spec) > 1 else None
+    imp_spec = (P(token_axis) if imp.ndim == 1
+                else P(hidden_spec[0] if hidden_spec else None, token_axis))
     for s, codec in enumerate(codecs):
-        if codec.needs_importance and hidden_spec != P():
-            # the closure-captured probe importance is full-length; a sharded
-            # hidden would pair a shard-local activation with full-length
-            # importance at trace time. SplitRingRuntime rejects non-
-            # batch-invariant codecs, so no caller hits this today.
-            raise NotImplementedError(
-                f"measure_hop_times: importance-carrying codec {codec.name!r} "
-                f"is incompatible with a sharded hidden_spec ({hidden_spec})")
 
-        def hop_body(h):
+        def hop_body(h, imp_loc):
             idx = jax.lax.axis_index("stage")
             if codec.needs_importance:
-                payload = codec.encode(h, imp)
+                payload = codec.encode(h, imp_loc)
             else:
                 payload = codec.encode(h)
             moved = jax.tree_util.tree_map(
@@ -158,9 +157,10 @@ def measure_hop_times(mesh, codecs, cfg, batch: int, seq: int, *,
             return jax.lax.psum(
                 jnp.where(idx == s + 1, decoded, jnp.zeros_like(decoded)), "stage")
 
-        fn = jax.jit(shard_map(hop_body, mesh=mesh, in_specs=hidden_spec,
+        fn = jax.jit(shard_map(hop_body, mesh=mesh,
+                               in_specs=(hidden_spec, imp_spec),
                                out_specs=hidden_spec, check_vma=False))
-        sec, _ = timed(fn, hidden, warmup=1, iters=iters)
+        sec, _ = timed(fn, hidden, imp, warmup=1, iters=iters)
         results.append(sec * 1000.0)
     return results
 

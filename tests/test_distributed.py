@@ -261,18 +261,26 @@ def _spawn_workers(out_dir, max_chunks=None, nprocs=2):
                          + os.pathsep + env.get("PYTHONPATH", ""))
     args = lambda r: [sys.executable, worker, str(r), str(nprocs), str(port),
                       str(out_dir)] + ([str(max_chunks)] if max_chunks else [])
-    procs = [subprocess.Popen(args(r), env=env, stdout=subprocess.PIPE,
+    # worker output goes to files, not pipes: a rank that out-writes the OS
+    # pipe buffer while the parent drains a sibling would block mid-collective
+    # and deadlock the group until the timeout
+    logs = [open(os.path.join(out_dir, f"worker_{r}.log"), "a+")
+            for r in range(nprocs)]
+    procs = [subprocess.Popen(args(r), env=env, stdout=logs[r],
                               stderr=subprocess.STDOUT, text=True)
              for r in range(nprocs)]
     done = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=600)
-            done.append((p.returncode, out))
+            p.wait(timeout=600)
     finally:
         for p in procs:  # never orphan the peer when one rank hangs/dies
             if p.poll() is None:
                 p.kill()
+        for r, (p, log) in enumerate(zip(procs, logs)):
+            log.seek(0)
+            done.append((p.returncode, log.read()))
+            log.close()
     for rc, out in done:
         assert rc == 0, f"worker failed (rc={rc}):\n{out[-4000:]}"
     return done
