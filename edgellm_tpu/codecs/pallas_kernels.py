@@ -584,16 +584,35 @@ _PALLAS_FACTORIES = {
     "ternary_max": lambda: pallas_ternary("max"),
 }
 
+#: Base codecs whose fused kernel MEASURABLY beats the jnp/XLA path on silicon
+#: (differential-scan roundtrip probe, repeated and decided on the median —
+#: single probe runs on the tunneled chip swing +-30% for the fastest bodies).
+#: Round-4 decision data (5 reps each): int4_per_token 1.33x (fuses the scale
+#: reduce + quantize + nibble pack), int4_per_channel ~1.4x, ternary ~1.4x;
+#: EXCLUDED: int8_per_token 0.80x, int8_per_channel ~0.92x, selective core
+#: ~0.97x — those are passes XLA already fuses into one bandwidth-bound sweep,
+#: so the kernel only adds launch/layout overhead. Substitution must be
+#: EARNED — a default path slower than doing nothing is worse than no kernel.
+PALLAS_DEFAULT_WINS = frozenset({
+    "int4_per_token", "int4_per_channel", "ternary_mean", "ternary_max"})
 
-def pallas_variant(codec: WireCodec) -> Optional[WireCodec]:
+
+def pallas_variant(codec: WireCodec, *, measured_wins_only: bool = False
+                   ) -> Optional[WireCodec]:
     """The Pallas-backed twin of a jnp wire codec, or None when no fused kernel
-    exists (identity casts — nothing to fuse). The split runtime uses this to
-    substitute kernels on TPU automatically."""
+    exists (identity casts — nothing to fuse). With ``measured_wins_only`` the
+    twin is returned only when it is a probed on-silicon win
+    (``PALLAS_DEFAULT_WINS``) — the TPU default-substitution policy; explicit
+    ``*_pallas`` pins are always honored."""
     if codec.name.endswith("_pallas"):
         return codec
     if codec.name in _PALLAS_FACTORIES:
+        if measured_wins_only and codec.name not in PALLAS_DEFAULT_WINS:
+            return None
         return _PALLAS_FACTORIES[codec.name]()
     if codec.name.startswith("selective_int4_r"):
+        if measured_wins_only:  # quantize core probed at 0.97x — not a win
+            return None
         ratio_high = codec.name[len("selective_int4_r"):]
         ratio_str, high = ratio_high.rsplit("_", 1)
         return pallas_selective_int4(float(ratio_str), high)

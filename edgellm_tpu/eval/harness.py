@@ -120,30 +120,103 @@ def _apply_token_codec(codec: str, hidden, importance, ratio, k):
 
 
 @functools.lru_cache(maxsize=None)
-def _stats_forward(cfg: ModelConfig):
-    """Jitted prefix pass: ids -> (attention stats, all boundary hiddens).
+def _stats_forward(cfg: ModelConfig, hidden_layers: tuple = None,
+                   tail: Optional[int] = None,
+                   stats_upto: Optional[int] = None):
+    """Jitted prefix pass: ids -> (attention stats, boundary hiddens[, NLL]).
 
-    No logits/NLL here: every (method, layer, ratio) combination -- including
-    ratio 0, the fp baseline -- gets its NLL from the suffix path, so computing
-    the full-vocab unembed in this pass would be pure waste.
+    Specialized to what the sweep consumes (round 4 — the original pass
+    captured stats and stacked hiddens for every layer, most never read):
+
+    - attention stats cover layers [0, stats_upto] (default: the deepest
+      hidden layer) — no importance method reads past its cut, and
+      ``aggregate_till``'s running means are prefix-local, so truncation is
+      exact;
+    - boundary hiddens are collected ONLY at ``hidden_layers`` (the full
+      (L, W, S, D) stack was 1.4 GB of HBM writes per 64-window flagship
+      group), returned stacked in sorted-layer order — index via
+      ``sorted(set(hidden_layers)).index(layer)``;
+    - with ``tail`` set, the layers past ``stats_upto`` run WITHOUT stats
+      capture and the final hidden is tail-scored: the returned per-window
+      NLL IS the method-independent ratio-0 fp baseline, replacing the old
+      separate separate baseline executable (a second full suffix forward
+      per group). With ``tail=None`` those layers never run at all.
+
+    ``hidden_layers=None`` keeps the original full-depth behavior (all
+    layers' stats + hiddens; no baseline).
     """
+    from ..models.transformer import embed
+
+    if hidden_layers is None:
+        @jax.jit
+        def full(params, ids, targets=None):
+            _, aux = run_layers_from_ids(cfg, params, ids, capture_stats=True)
+            return aux["stats"], aux["hiddens"], None
+
+        return full
+
+    from ..models.transformer import AttnStats
+
+    layers = tuple(sorted({int(l) for l in hidden_layers}))
+    upto = max(stats_upto if stats_upto is not None else 0, layers[-1])
 
     @jax.jit
-    def fn(params, ids):
-        _, aux = run_layers_from_ids(cfg, params, ids, capture_stats=True)
-        return aux["stats"], aux["hiddens"]
+    def fn(params, ids, targets=None):
+        h = embed(params, ids)
+        cols, lasts, hiddens = [], [], []
+        prev = 0
+        for cut in layers:
+            h, aux = run_layers(cfg, params, h, start=prev, stop=cut + 1,
+                                capture_stats=True)
+            cols.append(aux["stats"].col_mean)
+            lasts.append(aux["stats"].last_row)
+            hiddens.append(h)
+            prev = cut + 1
+        if prev <= upto:
+            h, aux = run_layers(cfg, params, h, start=prev, stop=upto + 1,
+                                capture_stats=True)
+            cols.append(aux["stats"].col_mean)
+            lasts.append(aux["stats"].last_row)
+            prev = upto + 1
+        stats = AttnStats(
+            col_mean=jnp.concatenate(cols) if len(cols) > 1 else cols[0],
+            last_row=jnp.concatenate(lasts) if len(lasts) > 1 else lasts[0])
+        base = None
+        if tail is not None:
+            out, _ = run_layers(cfg, params, h, start=prev)
+            base = nll_tail(cfg, params, out, targets, tail, per_example=True)
+        return stats, jnp.stack(hiddens), base
 
     return fn
 
 
 @functools.lru_cache(maxsize=None)
-def _plain_forward(cfg: ModelConfig):
-    """Jitted prefix pass without attention stats (channel sweep)."""
+def _plain_forward(cfg: ModelConfig, hidden_layers: tuple = None):
+    """Jitted prefix pass without attention stats (channel sweep); with
+    ``hidden_layers`` set, collects only those boundary hiddens (stacked in
+    sorted-layer order) and stops at the deepest one."""
+    from ..models.transformer import embed
+
+    if hidden_layers is None:
+        @jax.jit
+        def full(params, ids):
+            _, aux = run_layers_from_ids(cfg, params, ids, capture_stats=False)
+            return aux["hiddens"]
+
+        return full
+
+    layers = tuple(sorted({int(l) for l in hidden_layers}))
 
     @jax.jit
     def fn(params, ids):
-        _, aux = run_layers_from_ids(cfg, params, ids, capture_stats=False)
-        return aux["hiddens"]
+        h = embed(params, ids)
+        hiddens = []
+        prev = 0
+        for cut in layers:
+            h, _ = run_layers(cfg, params, h, start=prev, stop=cut + 1)
+            hiddens.append(h)
+            prev = cut + 1
+        return jnp.stack(hiddens)
 
     return fn
 
@@ -170,21 +243,6 @@ def _importance_stack(cfg: ModelConfig, methods: tuple):
 # of once per (method, layer) — the reference recomputes identical forwards
 # (``Qwen2-0.5B/main.py:170-178``); the values are unchanged.
 DEDUP_ZERO_CODECS = ("int4_token_select", "affine_int8_rank")
-
-
-@functools.lru_cache(maxsize=None)
-def _suffix_baseline(cfg: ModelConfig, layer: int, tail: int):
-    """Jitted: boundary hiddens at ``layer`` -> per-window fp NLL (no codec)."""
-
-    @jax.jit
-    def fn(params, boundary_hidden, targets):
-        def per_window(h_w, tgt_w):
-            out, _ = run_layers(cfg, params, h_w[None], start=layer + 1)
-            return nll_tail(cfg, params, out, tgt_w[None], tail)
-
-        return jax.vmap(per_window)(boundary_hidden, targets)
-
-    return fn
 
 
 @functools.lru_cache(maxsize=None)
@@ -577,15 +635,20 @@ def run_token_sweep(
               "ratios": list(ratios)},
         total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="token_weighted")
 
-    hw = None if head_weights is None else jnp.asarray(head_weights)
-    # ratio == 0 is the fp baseline: method-independent for the rank codecs, so
-    # run it once per layer and fill every method's column from that one call
+    # truncate head weights to the captured stats depth (weighted importance
+    # only consumes rows <= the deepest cut)
+    n_stats = max(int(l) for l in layers_of_interest) + 1
+    hw = None if head_weights is None else jnp.asarray(head_weights)[:n_stats]
+    # ratio == 0 is the fp baseline: method-independent for the rank codecs,
+    # so it is computed ONCE per group as the tail NLL of the stats forward's
+    # own full-depth continuation (no separate baseline executable)
     zero_idx = [i for i, r in enumerate(ratios) if float(r) == 0.0] \
         if codec in DEDUP_ZERO_CODECS else []
     nz_idx = [i for i in range(len(ratios)) if i not in zero_idx]
     nz_ratios = jnp.asarray(np.asarray([ratios[i] for i in nz_idx], np.float32))
-    stats_fn = _stats_forward(cfg)
     imp_fn = _importance_stack(cfg, tuple(methods))
+    layer_key = tuple(int(l) for l in layers_of_interest)
+    pos_of = {l: i for i, l in enumerate(sorted(set(layer_key)))}
 
     def submit(ids, targets, tail):
         """Enqueue all of one group's device work; NO host sync — returns the
@@ -594,13 +657,15 @@ def run_token_sweep(
         # int(ratio * s) (qwen_layer_wise.py:57) and the wire codecs
         ks = jnp.asarray([int(float(ratios[i]) * ids.shape[1]) for i in nz_idx],
                          jnp.int32)
-        stats, hiddens = stats_fn(params, ids)  # hiddens (L, W, S, D)
-        imp_all = imp_fn(stats, hw)  # (M, L, W, S), one device call
+        stats_fn = _stats_forward(cfg, layer_key,
+                                  tail if zero_idx else None)
+        stats, hiddens, base = stats_fn(params, ids, targets)
+        imp_all = imp_fn(stats, hw)  # (M, L', W, S), one device call
         pending = []  # (m_indices, l, ratio_indices, device_nlls)
         for l, layer in enumerate(layers_of_interest):
-            h_l = hiddens[layer]
+            h_l = hiddens[pos_of[int(layer)]]
             if zero_idx:
-                base = _suffix_baseline(cfg, int(layer), tail)(params, h_l, targets)
+                # layer-independent: no codec at ratio 0, any cut is a no-op
                 pending.append((range(len(methods)), l, zero_idx, base[None]))
             if nz_idx:
                 for m in range(len(methods)):
@@ -663,12 +728,16 @@ def run_initial_sweep(
         total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="mean_of_means")
 
     fracs = jnp.asarray([0.1 * r for r in ratios], jnp.float32)
-    stats_fn = _stats_forward(cfg)
+    # stats must cover every referenced layer: int specs, the fixed layer-2
+    # aggregations, and "upto ratio"'s quant-layer distribution
+    n_stats = max([quant_layer, 2] + [int(l) for l in layers_of_interest
+                                      if l not in magic]) + 1
+    stats_fn = _stats_forward(cfg, (quant_layer,), None, stats_upto=n_stats - 1)
 
     def submit(ids, targets, tail):
         ks = jnp.asarray([int(0.1 * r * ids.shape[1]) for r in ratios], jnp.int32)
-        stats, hiddens = stats_fn(params, ids)
-        reg = regular_importance(stats.col_mean)  # (L, W, S)
+        stats, hiddens, _ = stats_fn(params, ids)
+        reg = regular_importance(stats.col_mean)  # (L', W, S)
         pending = []
         for l, spec in enumerate(layers_of_interest):
             if spec == "aggregate upto 2":
@@ -680,7 +749,7 @@ def run_initial_sweep(
             else:
                 imp, codec = reg[int(spec)], "affine_int8_rank"
             pending.append((l, _suffix_sweep(cfg, quant_layer, codec, tail)(
-                params, hiddens[quant_layer], targets, imp, fracs, ks)))  # (R, W)
+                params, hiddens[0], targets, imp, fracs, ks)))  # (R, W)
         return pending
 
     def accumulate(pending, counts):
@@ -722,12 +791,13 @@ def run_channel_sweep(
         axes={"methods": list(methods), "layers_of_interest": list(layers_of_interest)},
         total_nll=np.zeros(shape), n_tokens=0.0, chunks=0, weighting="token_weighted")
 
-    fwd = _plain_forward(cfg)
+    fwd = _plain_forward(cfg, tuple(int(l) for l in layers_of_interest))
+    pos_of = {l: i for i, l in enumerate(sorted({int(l) for l in layers_of_interest}))}
 
     def submit(ids, targets, tail):
-        hiddens = fwd(params, ids)  # (L, W, S, D)
+        hiddens = fwd(params, ids)  # (n_interest, W, S, D)
         return [(m, l, _suffix_channel(cfg, int(layer), method, tail)(
-                    params, hiddens[layer], targets))  # (W,)
+                    params, hiddens[pos_of[int(layer)]], targets))  # (W,)
                 for m, method in enumerate(methods)
                 for l, layer in enumerate(layers_of_interest)]
 

@@ -1,0 +1,194 @@
+"""Whole-sequence-in-VMEM causal attention kernel for small-head models.
+
+Why this exists: the flagship qwen2-0.5b has ``head_dim=64`` — half the MXU
+lane width — and at the sweep's shapes (S=512, B*R up to 256 rows) XLA's
+fused ``jax.nn.dot_product_attention`` measures ~18 TF/s on the v5e while the
+same chip does 194 TF/s on big matmuls; the generic Pallas flash/splash
+kernels (built for long S, hd>=128) measure slower still. This kernel takes
+the opposite design point: at S <= 1024 the ENTIRE (S, S) score matrix of one
+(batch, head) pair fits VMEM, so each grid step computes
+scores -> causal mask -> softmax -> PV in one pass with zero HBM traffic for
+intermediates — no flash blocking, no online-softmax recurrence.
+
+Measured design notes (differential-scan timings on the v5e, round 4):
+
+- the big (S, hd) x (hd, S) ops are what the MXU wants: in-kernel fori flash
+  tiling measured 27 TF/s (T=2) / 14 TF/s (T=4), and a 2-way causal split
+  (25% fewer flops but 2x smaller matmuls) measured 33 TF/s — all SLOWER
+  than the 43-46 TF/s untiled full square, so the causal upper triangle is
+  deliberately computed and masked;
+- all ``rep = H // KV`` query heads of one KV group run per grid step: K/V
+  are fetched once per group (the GQA broadcast costs no HBM traffic) and
+  the longer step amortizes grid overhead (43.5 -> 45.9 TF/s);
+- per-matmul anatomy: QK alone 34 TF/s, PV alone 31 TF/s, both overlap to
+  ~45-50 — the kernel is MXU-bound at the hd=64 padding limit, softmax adds
+  only ~15%;
+- q and the output stay PACKED as (B, S, H*hd) — the natural projection
+  layout — with heads as static column slices of the block, so the two big
+  (B, S, H, hd) <-> (B, H, S, hd) transposes never exist (38.3 -> 43.5 TF/s
+  end-to-end at the sweep's 256-row batches); only the KV/H-fold smaller K/V
+  are transposed.
+
+Net: ~2.4x XLA's fused attention at the flagship shapes (43.5 TF/s vs 18.4
+at B=256), measured end-to-end from the model's layout.
+
+The stats variant additionally emits the column-sum and last-query-row
+statistics the importance metrics consume (``AttnStats``), read directly off
+the in-VMEM probability matrix — the fused replacement for the blocked-scan
+stats capture in ``transformer.attention`` (reference constraint: a SECOND
+eager model instance just to get attention maps,
+``Experiments/Pythia-70M/last_row_exp.py:66-70``).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+#: one head's in-flight score/prob matrices must fit VMEM alongside the
+#: double-buffered blocks; S=1024 (4 MB fp32 scores) compile- and run-checked
+#: on the v5e (only one head's matrices are live at a time — Mosaic schedules
+#: the rest), S=2048 (16 MB) cannot fit
+MAX_WHOLE_S = 1024
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def kernel_eligible(seq: int, backend_check: bool = True) -> bool:
+    """True when the whole-S kernel should handle this shape by default:
+    TPU backend, sequence short enough for in-VMEM scores. EDGELLM_ATTN
+    forces the kernel (=pallas) or the XLA path (=xla) on any backend."""
+    flag = os.environ.get("EDGELLM_ATTN")
+    if flag == "xla":
+        return False
+    if flag == "pallas":
+        return seq <= MAX_WHOLE_S
+    return seq <= MAX_WHOLE_S and (not backend_check
+                                   or jax.default_backend() == "tpu")
+
+
+def _head_attn(q, k, v):
+    """One head's causal attention, entirely in VMEM -> (out, probs)."""
+    s, hd = q.shape
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * (1.0 / np.sqrt(hd))
+    row = jax.lax.broadcasted_iota(jnp.int32, (s, s), 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, (s, s), 1)
+    scores = jnp.where(row >= col, scores, -1e30)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - m)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(
+        p.astype(q.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return out.astype(q.dtype), p
+
+
+def _attn_packed_kernel(q_ref, k_ref, v_ref, o_ref, *, hd):
+    """Grid (B,): one batch row, every head, PACKED (S, H*hd) q/out layout.
+
+    The packed layout is the natural shape of the QKV projection output, so
+    the (B, S, H, hd) -> (B, H, S, hd) transpose of q and of the output —
+    hundreds of MB each way per layer at the sweep's 256-row batches — never
+    exists; each head is a STATIC column slice of the block. K/V still use
+    the (B, KV, S, hd) layout (their transpose is KV/H-fold smaller)."""
+    kv = k_ref.shape[1]
+    rep = (q_ref.shape[2] // hd) // kv
+    for j in range(kv):
+        k = k_ref[0, j]
+        v = v_ref[0, j]
+        for g in range(rep):
+            c0 = (j * rep + g) * hd
+            out, _ = _head_attn(q_ref[0, :, c0:c0 + hd], k, v)
+            o_ref[0, :, c0:c0 + hd] = out.astype(o_ref.dtype)
+
+
+def _attn_packed_stats_kernel(q_ref, k_ref, v_ref, o_ref, col_ref, last_ref,
+                              *, hd):
+    kv = k_ref.shape[1]
+    rep = (q_ref.shape[2] // hd) // kv
+    s = k_ref.shape[2]
+    for j in range(kv):
+        k = k_ref[0, j]
+        v = v_ref[0, j]
+        for g in range(rep):
+            c0 = (j * rep + g) * hd
+            out, p = _head_attn(q_ref[0, :, c0:c0 + hd], k, v)
+            o_ref[0, :, c0:c0 + hd] = out.astype(o_ref.dtype)
+            col_ref[0, j * rep + g, 0] = jnp.sum(p, axis=0) * (1.0 / s)
+            last_ref[0, j * rep + g, 0] = p[s - 1, :]
+
+
+@functools.partial(jax.jit, static_argnames=("hd", "interpret"))
+def _attn_packed(q2, kt, vt, hd: int, interpret: bool):
+    """q2 (B, S, H*hd) packed; kt/vt (B, KV, S, hd) -> out (B, S, H*hd)."""
+    b, s, dh = q2.shape
+    kv = kt.shape[1]
+    spec_q = pl.BlockSpec((1, s, dh), lambda i: (i, 0, 0))
+    spec_kv = pl.BlockSpec((1, kv, s, hd), lambda i: (i, 0, 0, 0))
+    return pl.pallas_call(
+        functools.partial(_attn_packed_kernel, hd=hd),
+        grid=(b,),
+        in_specs=[spec_q, spec_kv, spec_kv],
+        out_specs=spec_q,
+        out_shape=jax.ShapeDtypeStruct((b, s, dh), q2.dtype),
+        interpret=interpret,
+    )(q2, kt, vt)
+
+
+@functools.partial(jax.jit, static_argnames=("hd", "interpret"))
+def _attn_packed_stats(q2, kt, vt, hd: int, interpret: bool):
+    b, s, dh = q2.shape
+    kv = kt.shape[1]
+    h = dh // hd
+    spec_q = pl.BlockSpec((1, s, dh), lambda i: (i, 0, 0))
+    spec_kv = pl.BlockSpec((1, kv, s, hd), lambda i: (i, 0, 0, 0))
+    spec_s = pl.BlockSpec((1, h, 1, s), lambda i: (i, 0, 0, 0))
+    out, col, last = pl.pallas_call(
+        functools.partial(_attn_packed_stats_kernel, hd=hd),
+        grid=(b,),
+        in_specs=[spec_q, spec_kv, spec_kv],
+        out_specs=[spec_q, spec_s, spec_s],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, s, dh), q2.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, s), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q2, kt, vt)
+    return out, col[:, :, 0, :], last[:, :, 0, :]
+
+
+def causal_attention(q, k, v, *, interpret: bool | None = None):
+    """Causal attention from the model's (B, S, H, hd) layout; K/V may carry
+    fewer (grouped-query) heads. Returns (B, S, H, hd).
+
+    q rides through the kernel PACKED as (B, S, H*hd) — a free reshape of the
+    projection output, no transpose; only the small K/V get transposed."""
+    if interpret is None:
+        interpret = _use_interpret()
+    b, s, h, hd = q.shape
+    out = _attn_packed(q.reshape(b, s, h * hd),
+                       jnp.transpose(k, (0, 2, 1, 3)),
+                       jnp.transpose(v, (0, 2, 1, 3)), hd, interpret)
+    return out.reshape(b, s, h, hd)
+
+
+def causal_attention_stats(q, k, v, *, interpret: bool | None = None):
+    """Causal attention + (col_sum/S, last_row) stats, from (B, S, H, hd).
+    Returns (out (B, S, H, hd), (col_sum (B, H, S), last_row (B, H, S)))."""
+    if interpret is None:
+        interpret = _use_interpret()
+    b, s, h, hd = q.shape
+    out, col, last = _attn_packed_stats(q.reshape(b, s, h * hd),
+                                        jnp.transpose(k, (0, 2, 1, 3)),
+                                        jnp.transpose(v, (0, 2, 1, 3)),
+                                        hd, interpret)
+    return out.reshape(b, s, h, hd), (col, last)

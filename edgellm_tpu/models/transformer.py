@@ -177,18 +177,40 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
     k = apply_rotary(k, cos, sin, cfg.rotary_dim)
 
     if not capture_stats:
-        # Hot path: fused attention (XLA picks a flash-style schedule; no O(S^2)
-        # probs materialized in HBM). GQA broadcast is handled natively. This is
-        # the analogue of the reference's SDPA instance for quantized forwards
-        # (pythia_model.py:25) while the eager branch below replaces its second,
-        # eager-attention model (last_row_exp.py:68).
-        out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
+        # Hot path. On TPU at S <= 1024 the whole-S Pallas kernel (one
+        # (batch, head) score matrix per grid step, entirely in VMEM) measures
+        # ~2.4x XLA's fused attention at the flagship's hd=64 shapes
+        # (models/flash_attention.py); elsewhere XLA's fused path (flash-style
+        # schedule, no O(S^2) HBM probs, native GQA). This is the analogue of
+        # the reference's SDPA instance for quantized forwards
+        # (pythia_model.py:25) while the stats branch below replaces its
+        # second, eager-attention model (last_row_exp.py:68).
+        from .flash_attention import causal_attention, kernel_eligible
+
+        if kernel_eligible(s):
+            out = causal_attention(q, k, v)
+        else:
+            out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
         out = out.reshape(b, s, h * hd) @ lp["wo"]
         if tp_axis is not None:
             out = jax.lax.psum(out, tp_axis)
         if "bo" in lp:
             out = out + lp["bo"]
         return out, None
+
+    from .flash_attention import causal_attention_stats, kernel_eligible
+
+    if stats_block is None and kernel_eligible(s):
+        # fused stats capture: col_sum and last_row read directly off the
+        # in-VMEM probability matrix (the blocked-scan path below stays as
+        # the portable implementation and, at stats_block=0, the oracle)
+        out, stats = causal_attention_stats(q, k, v)
+        out = out.reshape(b, s, h * hd) @ lp["wo"]
+        if tp_axis is not None:
+            out = jax.lax.psum(out, tp_axis)
+        if "bo" in lp:
+            out = out + lp["bo"]
+        return out, stats
 
     rep = h // kv
     if rep > 1:  # grouped-query attention: repeat KV heads

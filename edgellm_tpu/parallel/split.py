@@ -54,15 +54,23 @@ def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
 def apply_default_codec_backend(codecs: list) -> list:
     """Resolve hop-codec specs (names or ``WireCodec`` instances) to the
     backend's default implementation. On TPU the fused Pallas kernels are the
-    default (bit-identical to the jnp twins — tested); EDGELLM_PALLAS forces
-    substitution on (=1) or off (=0) on any backend. Shared by every runtime
+    default — but only where the kernel is a MEASURED on-silicon win
+    (``pallas_kernels.PALLAS_DEFAULT_WINS``; the probe showed int8_per_channel
+    and the selective core marginally slower than their already-fused jnp
+    twins, so those stay on XLA by default). EDGELLM_PALLAS forces
+    substitution of every kernel twin (=1) or none (=0) on any backend;
+    explicit ``*_pallas`` names are always honored. Shared by every runtime
     that owns hop codecs."""
     codecs = [c if isinstance(c, WireCodec) else get_wire_codec(c) for c in codecs]
     flag = os.environ.get("EDGELLM_PALLAS")
-    if flag == "1" or (flag is None and jax.default_backend() == "tpu"):
+    if flag == "1":
         from ..codecs.pallas_kernels import pallas_variant
 
         return [pallas_variant(c) or c for c in codecs]
+    if flag is None and jax.default_backend() == "tpu":
+        from ..codecs.pallas_kernels import pallas_variant
+
+        return [pallas_variant(c, measured_wins_only=True) or c for c in codecs]
     return codecs
 
 

@@ -198,10 +198,16 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
     dec_ulp = _ulp_diff(dec_got, dec_want)
     assert dec_ulp <= max_ulp, f"{name} decode: {dec_ulp} ulp > {max_ulp}"
 
+    from edgellm_tpu.codecs.pallas_kernels import PALLAS_DEFAULT_WINS
+
     result = {
         "codec": name,
         "backend": jax.default_backend(),
         "shape": [batch, seq, dim],
+        # whether the TPU default path substitutes this kernel (the measured-
+        # win policy, split.apply_default_codec_backend); non-default twins
+        # stay probed for parity and remain pinnable via *_pallas names
+        "default_substituted": name in PALLAS_DEFAULT_WINS,
         "int_leaves_bit_identical": n_int,
         "encode_max_ulp": enc_ulp,
         "decode_max_ulp": dec_ulp,
@@ -227,7 +233,14 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
                  else codec.encode(xi))
             return p, codec.decode(p)
 
-        return _timed_scan(body, xs, pool)
+        # median of 3 differentials: single scans on the tunneled chip swing
+        # +-30% for the fastest bodies (round-4 decision data), enough to make
+        # a genuinely faster kernel probe below 1.0 — the substitution policy
+        # and its >=1.0 audit need a stable estimator (executables cache, so
+        # the extra scans cost readbacks, not compiles)
+        ts = [t for t in (_timed_scan(body, xs, pool) for _ in range(3))
+              if math.isfinite(t)]
+        return sorted(ts)[len(ts) // 2] if ts else float("nan")
 
     # a NaN differential means that body stayed inside the tunnel's call
     # jitter even after escalation — omit its fields rather than emit a

@@ -43,18 +43,29 @@ def _budget_bytes(hbm_bytes: Optional[int], budget_frac: float) -> int:
 
 def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
                               tail: int, layer: int, codec: str,
-                              n_ratios: int, dtype) -> dict:
-    """Estimated HBM peak of the token sweep at one window batch (bytes)."""
+                              n_ratios: int, dtype,
+                              layers: Optional[Sequence[int]] = None) -> dict:
+    """Estimated HBM peak of the token sweep at one window batch (bytes).
+
+    ``layers`` is the full ``layers_of_interest`` tuple (defaults to
+    ``(layer,)``) — the stats forward collects hiddens only at those layers
+    and captures stats only up to the deepest one, so the estimate mirrors
+    the executables ``run_token_sweep`` actually compiles."""
     import jax
     import jax.numpy as jnp
 
-    from ..eval.harness import _stats_forward, _suffix_sweep
+    from ..eval.harness import (DEDUP_ZERO_CODECS, _stats_forward,
+                                _suffix_sweep)
     from ..models import init_params
 
-    W, S, L, D = window_batch, max_length, cfg.num_layers, cfg.hidden_size
+    layers = tuple(int(l) for l in (layers if layers is not None else (layer,)))
+    W, S, D = window_batch, max_length, cfg.hidden_size
+    n_interest = len(set(layers))
+    n_stats = max(layers) + 1
     params_shape = jax.eval_shape(
         lambda k: init_params(cfg, k, dtype=dtype), jax.random.key(0))
     ids = jax.ShapeDtypeStruct((W, S), jnp.int32)
+    targets = jax.ShapeDtypeStruct((W, S), jnp.int32)
 
     def call_bytes(lowered) -> Optional[int]:
         """argument+output+temp bytes, or None when the TPU compiler itself
@@ -70,10 +81,11 @@ def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
         return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
                    + ma.temp_size_in_bytes)
 
-    stats = call_bytes(_stats_forward(cfg).lower(params_shape, ids))
+    stats_tail = tail if codec in DEDUP_ZERO_CODECS else None
+    stats = call_bytes(_stats_forward(cfg, layers, stats_tail)
+                       .lower(params_shape, ids, targets))
 
     hidden = jax.ShapeDtypeStruct((W, S, D), dtype)
-    targets = jax.ShapeDtypeStruct((W, S), jnp.int32)
     imp = jax.ShapeDtypeStruct((W, S), jnp.float32)
     ratios = jax.ShapeDtypeStruct((n_ratios,), jnp.float32)
     ks = jax.ShapeDtypeStruct((n_ratios,), jnp.int32)
@@ -84,8 +96,8 @@ def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
         return {"stats_call": stats, "suffix_call": suffix,
                 "hiddens_stack": 0, "peak": float("inf")}
     itemsize = jnp.dtype(dtype).itemsize
-    hiddens_stack = L * W * S * D * itemsize  # collect_hidden output, per group
-    stats_buf = 2 * L * W * cfg.num_heads * S * 4  # col_mean + last_row, fp32
+    hiddens_stack = n_interest * W * S * D * itemsize  # collected boundaries
+    stats_buf = 2 * n_stats * W * cfg.num_heads * S * 4  # col_mean + last_row
     # worst single call + the other live group state the call's args don't hold:
     # the suffix sees one (W,S,D) slice as an arg while BOTH groups' full
     # stacks are alive (submit/drain double buffering)
@@ -113,7 +125,8 @@ def preflight_token_sweep_batch(cfg, requested: int, *, max_length: int,
         cfg, requested, max_length=max_length, tail=stride + 1,
         layer=min(int(l) for l in layers_of_interest), codec=codec,
         n_ratios=max(n_ratios, 1), dtype=dtype,
-        hbm_bytes=hbm_bytes, budget_frac=budget_frac)
+        hbm_bytes=hbm_bytes, budget_frac=budget_frac,
+        layers=tuple(int(l) for l in layers_of_interest))
     return wb
 
 
@@ -156,13 +169,14 @@ def largest_fitting_window_batch(cfg, requested: int, *, max_length: int,
                                  n_ratios: int, dtype,
                                  hbm_bytes: Optional[int] = None,
                                  budget_frac: float = 0.8,
-                                 min_window_batch: int = 1) -> tuple:
+                                 min_window_batch: int = 1,
+                                 layers: Optional[Sequence[int]] = None) -> tuple:
     """Halve ``requested`` until the estimated peak fits -> (wb, estimate)."""
     budget = _budget_bytes(hbm_bytes, budget_frac)
     wb = requested
     while True:
         est = estimate_sweep_peak_bytes(cfg, wb, max_length, tail, layer,
-                                        codec, n_ratios, dtype)
+                                        codec, n_ratios, dtype, layers=layers)
         if est["peak"] <= budget or wb <= min_window_batch:
             return wb, est
         wb = max(wb // 2, min_window_batch)

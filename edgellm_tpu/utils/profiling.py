@@ -66,12 +66,17 @@ def measure_peak_tflops(sizes=(4096, 6144), pool: int = 4,
                         ).astype(jnp.bfloat16)
         bs = jnp.asarray(rng.standard_normal((pool, n, n)).astype(np.float32)
                          ).astype(jnp.bfloat16)
+        # MEDIAN of the sane attempts: a single differential can land +-15%
+        # on the tunnel (round-4 observed 184-240 TF/s for the same chip),
+        # and the MFU-vs-measured ratio is only as honest as this denominator
+        vals = []
         for _ in range(attempts):
             t = _timed_scan(
                 lambda b_mat: jnp.dot(a, b_mat, preferred_element_type=jnp.float32),
                 bs, pool, lengths=(32, 256))
             tflops = 2.0 * n ** 3 / t / 1e12
             if 10.0 < tflops < 2000.0:  # sane for any current single chip
-                best = max(best or 0.0, tflops)
-                break
+                vals.append(tflops)
+        if vals:
+            best = max(best or 0.0, sorted(vals)[len(vals) // 2])
     return best

@@ -117,6 +117,34 @@ def test_split_runtime_substitutes_pallas_when_forced(rng, monkeypatch):
                                atol=1e-6, rtol=1e-6)
 
 
+def test_default_substitution_is_gated_on_measured_wins(monkeypatch):
+    """The TPU default path substitutes only kernels the probe measured as
+    wins; int8_per_channel (0.94x) and the selective core (0.97x) stay on
+    their jnp twins unless EDGELLM_PALLAS=1 forces every twin. Explicit
+    *_pallas pins are always honored."""
+    import jax
+    from edgellm_tpu.codecs.packing import selective_int4
+    from edgellm_tpu.parallel.split import apply_default_codec_backend
+
+    monkeypatch.delenv("EDGELLM_PALLAS", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    out = apply_default_codec_backend(
+        ["int4_per_token", "int8_per_token", selective_int4(0.5, "bf16"),
+         "int8_per_channel_pallas"])
+    assert [c.name for c in out] == [
+        "int4_per_token_pallas",       # measured win (1.33x) -> substituted
+        "int8_per_token",              # 0.80x -> stays jnp
+        "selective_int4_r0.5_bf16",    # 0.97x core -> stays jnp
+        "int8_per_channel_pallas",     # explicit pin honored
+    ]
+
+    monkeypatch.setenv("EDGELLM_PALLAS", "1")
+    forced = apply_default_codec_backend(
+        ["int8_per_channel", selective_int4(0.5, "bf16")])
+    assert [c.name for c in forced] == [
+        "int8_per_channel_pallas", "selective_int4_r0.5_bf16_pallas"]
+
+
 def test_pallas_codec_in_split_runtime(rng):
     """Pallas hop codec through ppermute == jnp hop codec, end to end."""
     import jax
