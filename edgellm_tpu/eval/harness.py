@@ -139,7 +139,7 @@ def _stats_forward(cfg: ModelConfig, hidden_layers: tuple = None,
     - with ``tail`` set, the layers past ``stats_upto`` run WITHOUT stats
       capture and the final hidden is tail-scored: the returned per-window
       NLL IS the method-independent ratio-0 fp baseline, replacing the old
-      separate separate baseline executable (a second full suffix forward
+      separate baseline executable (a second full suffix forward
       per group). With ``tail=None`` those layers never run at all.
 
     ``hidden_layers=None`` keeps the original full-depth behavior (all

@@ -54,23 +54,32 @@ from jax.experimental import pallas as pl
 #: on the v5e (only one head's matrices are live at a time — Mosaic schedules
 #: the rest), S=2048 (16 MB) cannot fit
 MAX_WHOLE_S = 1024
+#: widest packed q/out row validated on silicon: dh=896 (flagship, 2.4x XLA)
+#: and dh=1536 (qwen2-1.5b hd=128, 3.45x XLA) compile and win; dh=2048
+#: (llama-1b, 32 heads) exceeds scoped VMEM by ~2 MB at S=512 — wider models
+#: stay on XLA's fused path like the codec kernels stay unsubstituted until
+#: a win is measured
+MAX_PACKED_DH = 1536
 
 
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def kernel_eligible(seq: int, backend_check: bool = True) -> bool:
-    """True when the whole-S kernel should handle this shape by default:
-    TPU backend, sequence short enough for in-VMEM scores. EDGELLM_ATTN
-    forces the kernel (=pallas) or the XLA path (=xla) on any backend."""
+def kernel_eligible(seq: int, model_dim: int = 0,
+                    backend_check: bool = True) -> bool:
+    """True when the whole-S kernel should handle this (S, H*hd) shape by
+    default: TPU backend, sequence short enough for in-VMEM scores, packed
+    row within the silicon-validated width. EDGELLM_ATTN forces the kernel
+    (=pallas) or the XLA path (=xla) on any backend — the force still honors
+    the VMEM-driven shape limits."""
     flag = os.environ.get("EDGELLM_ATTN")
+    fits = seq <= MAX_WHOLE_S and model_dim <= MAX_PACKED_DH
     if flag == "xla":
         return False
     if flag == "pallas":
-        return seq <= MAX_WHOLE_S
-    return seq <= MAX_WHOLE_S and (not backend_check
-                                   or jax.default_backend() == "tpu")
+        return fits
+    return fits and (not backend_check or jax.default_backend() == "tpu")
 
 
 def _head_attn(q, k, v):

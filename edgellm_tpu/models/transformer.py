@@ -176,41 +176,41 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
     q = apply_rotary(q, cos, sin, cfg.rotary_dim)
     k = apply_rotary(k, cos, sin, cfg.rotary_dim)
 
-    if not capture_stats:
-        # Hot path. On TPU at S <= 1024 the whole-S Pallas kernel (one
-        # (batch, head) score matrix per grid step, entirely in VMEM) measures
-        # ~2.4x XLA's fused attention at the flagship's hd=64 shapes
-        # (models/flash_attention.py); elsewhere XLA's fused path (flash-style
-        # schedule, no O(S^2) HBM probs, native GQA). This is the analogue of
-        # the reference's SDPA instance for quantized forwards
-        # (pythia_model.py:25) while the stats branch below replaces its
-        # second, eager-attention model (last_row_exp.py:68).
-        from .flash_attention import causal_attention, kernel_eligible
-
-        if kernel_eligible(s):
-            out = causal_attention(q, k, v)
-        else:
-            out = jax.nn.dot_product_attention(q, k, v, is_causal=True)
-        out = out.reshape(b, s, h * hd) @ lp["wo"]
-        if tp_axis is not None:
-            out = jax.lax.psum(out, tp_axis)
-        if "bo" in lp:
-            out = out + lp["bo"]
-        return out, None
-
-    from .flash_attention import causal_attention_stats, kernel_eligible
-
-    if stats_block is None and kernel_eligible(s):
-        # fused stats capture: col_sum and last_row read directly off the
-        # in-VMEM probability matrix (the blocked-scan path below stays as
-        # the portable implementation and, at stats_block=0, the oracle)
-        out, stats = causal_attention_stats(q, k, v)
+    def project_out(out, stats):
+        """The shared output epilogue: row-split projection, tp reduction,
+        bias — one copy for the kernel, XLA, and blocked-scan paths."""
         out = out.reshape(b, s, h * hd) @ lp["wo"]
         if tp_axis is not None:
             out = jax.lax.psum(out, tp_axis)
         if "bo" in lp:
             out = out + lp["bo"]
         return out, stats
+
+    from .flash_attention import (causal_attention, causal_attention_stats,
+                                  kernel_eligible)
+
+    use_kernel = kernel_eligible(s, h * hd)
+    if not capture_stats:
+        # Hot path. On TPU at S <= 1024 the whole-S Pallas kernel (one
+        # (batch, head) score matrix per grid step, entirely in VMEM) measures
+        # ~2.4x XLA's fused attention at the flagship's hd=64 shapes and
+        # ~3.4x at qwen2-1.5b's hd=128 (models/flash_attention.py); wider or
+        # longer shapes use XLA's fused path (flash-style schedule, no O(S^2)
+        # HBM probs, native GQA). This is the analogue of the reference's
+        # SDPA instance for quantized forwards (pythia_model.py:25) while the
+        # stats branch below replaces its second, eager-attention model
+        # (last_row_exp.py:68).
+        if use_kernel:
+            return project_out(causal_attention(q, k, v), None)
+        return project_out(
+            jax.nn.dot_product_attention(q, k, v, is_causal=True), None)
+
+    if stats_block is None and use_kernel:
+        # fused stats capture: col_sum and last_row read directly off the
+        # in-VMEM probability matrix (the blocked-scan path below stays as
+        # the portable implementation and, at stats_block=0, the oracle)
+        out, stats = causal_attention_stats(q, k, v)
+        return project_out(out, stats)
 
     rep = h // kv
     if rep > 1:  # grouped-query attention: repeat KV heads
@@ -255,14 +255,7 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
             jnp.einsum("bhd,bthd->bht", q[:, -1], k,
                        preferred_element_type=jnp.float32) * inv_scale, axis=-1)
 
-    out = out.reshape(b, s, h * hd) @ lp["wo"]
-    if tp_axis is not None:
-        out = jax.lax.psum(out, tp_axis)
-    if "bo" in lp:
-        out = out + lp["bo"]
-
-    stats = (col_sum / s, last_row)  # (B, H, S) each
-    return out, stats
+    return project_out(out, (col_sum / s, last_row))  # stats (B, H, S) each
 
 
 def mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,

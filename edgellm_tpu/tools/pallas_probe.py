@@ -238,9 +238,11 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
         # a genuinely faster kernel probe below 1.0 — the substitution policy
         # and its >=1.0 audit need a stable estimator (executables cache, so
         # the extra scans cost readbacks, not compiles)
+        import statistics
+
         ts = [t for t in (_timed_scan(body, xs, pool) for _ in range(3))
               if math.isfinite(t)]
-        return sorted(ts)[len(ts) // 2] if ts else float("nan")
+        return statistics.median(ts) if ts else float("nan")
 
     # a NaN differential means that body stayed inside the tunnel's call
     # jitter even after escalation — omit its fields rather than emit a

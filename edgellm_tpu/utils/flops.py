@@ -43,24 +43,32 @@ def token_sweep_flops_per_chunk(
     n_zero_ratios: int = 0,
 ) -> float:
     """Model FLOPs the restructured token sweep performs for ONE evaluation
-    window: a full stats forward plus, per (method, layer, ratio), a layer
-    suffix from the boundary and a ``tail``-position unembed.
-
-    This is the work actually executed — the honest numerator for MFU. The
+    window — the work actually executed, the honest numerator for MFU. The
     reference performs strictly more (a full forward incl. full unembed per
-    combination, ``Qwen2-0.5B/main.py:170-178``). ``n_zero_ratios``: how many
-    ratios are the fp baseline that the harness dedupes across methods (one
-    baseline suffix per layer instead of ``n_methods`` identical ones —
-    ``DEDUP_ZERO_CODECS`` in ``eval/harness.py``); pass 0 for codecs that don't
-    dedupe.
+    combination, ``Qwen2-0.5B/main.py:170-178``).
+
+    Mirrors ``run_token_sweep``'s round-4 executables exactly:
+
+    - ``n_zero_ratios > 0`` (a ``DEDUP_ZERO_CODECS`` codec): the stats forward
+      runs ALL layers and its final hidden is tail-scored ONCE — that single
+      extra unembed IS the method- and layer-independent fp baseline; no
+      baseline suffix forward exists anymore;
+    - ``n_zero_ratios == 0``: no baseline is needed, so the stats forward
+      stops at the deepest layer of interest;
+    - per (method, layer, nonzero ratio): a layer suffix from the boundary
+      plus a ``tail``-position unembed.
     """
     per_layer = layer_flops_per_token(cfg, seq_len)
-    stats_fwd = cfg.num_layers * per_layer * seq_len
     tail = min(tail, seq_len - 1)
+    unembed = unembed_flops_per_position(cfg) * tail
+    if n_zero_ratios > 0:
+        stats_fwd = cfg.num_layers * per_layer * seq_len + unembed
+    else:
+        stats_fwd = (max(int(l) for l in layers_of_interest) + 1) \
+            * per_layer * seq_len
     suffix = 0.0
-    n_suffixes = n_methods * (n_ratios - n_zero_ratios) + min(n_zero_ratios, 1)
+    n_suffixes = n_methods * (n_ratios - n_zero_ratios)
     for layer in layers_of_interest:
         suffix_layers = cfg.num_layers - int(layer) - 1
-        suffix += n_suffixes * (suffix_layers * per_layer * seq_len
-                                + unembed_flops_per_position(cfg) * tail)
+        suffix += n_suffixes * (suffix_layers * per_layer * seq_len + unembed)
     return stats_fwd + suffix

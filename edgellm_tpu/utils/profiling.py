@@ -44,7 +44,7 @@ def throughput(fn, *args, tokens: int, **kwargs) -> dict:
 
 
 def measure_peak_tflops(sizes=(4096, 6144), pool: int = 4,
-                        attempts: int = 3):
+                        attempts: int = 3, cap: float = None):
     """The chip's ACHIEVABLE bf16 matmul peak (TF/s): best sustained rate of a
     few large square matmuls, measured with the differential-scan harness that
     cancels the axon tunnel's fixed per-call cost. This is the honest MFU
@@ -68,15 +68,21 @@ def measure_peak_tflops(sizes=(4096, 6144), pool: int = 4,
                          ).astype(jnp.bfloat16)
         # MEDIAN of the sane attempts: a single differential can land +-15%
         # on the tunnel (round-4 observed 184-240 TF/s for the same chip),
-        # and the MFU-vs-measured ratio is only as honest as this denominator
+        # and the MFU-vs-measured ratio is only as honest as this denominator.
+        # ``cap`` (the spec-sheet peak) rejects provably-impossible samples:
+        # a chip cannot beat its own spec, so a supra-spec differential means
+        # the timing underestimated, never that the chip overdelivered.
+        hi = min(2000.0, cap * 1.05) if cap else 2000.0
         vals = []
         for _ in range(attempts):
             t = _timed_scan(
                 lambda b_mat: jnp.dot(a, b_mat, preferred_element_type=jnp.float32),
                 bs, pool, lengths=(32, 256))
             tflops = 2.0 * n ** 3 / t / 1e12
-            if 10.0 < tflops < 2000.0:  # sane for any current single chip
+            if 10.0 < tflops < hi:
                 vals.append(tflops)
         if vals:
-            best = max(best or 0.0, sorted(vals)[len(vals) // 2])
-    return best
+            import statistics
+
+            best = max(best or 0.0, statistics.median(vals))
+    return min(best, cap) if (best and cap) else best

@@ -86,9 +86,11 @@ def test_model_attention_same_under_either_backend(rng, monkeypatch):
 def test_kernel_eligibility(monkeypatch):
     monkeypatch.delenv("EDGELLM_ATTN", raising=False)
     # CPU default: no kernel (interpret mode would be slow, XLA is fine)
-    assert not kernel_eligible(512)
+    assert not kernel_eligible(512, 896)
     monkeypatch.setenv("EDGELLM_ATTN", "pallas")
-    assert kernel_eligible(512)
-    assert not kernel_eligible(2048)  # whole-S scores would blow VMEM
+    assert kernel_eligible(512, 896)
+    assert kernel_eligible(512, 1536)   # qwen2-1.5b: measured 3.4x win
+    assert not kernel_eligible(2048, 896)  # whole-S scores would blow VMEM
+    assert not kernel_eligible(512, 2048)  # llama-1b row: scoped-VMEM OOM
     monkeypatch.setenv("EDGELLM_ATTN", "xla")
-    assert not kernel_eligible(512)
+    assert not kernel_eligible(512, 896)
