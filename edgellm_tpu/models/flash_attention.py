@@ -66,7 +66,7 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def kernel_eligible(seq: int, model_dim: int = 0,
+def kernel_eligible(seq: int, model_dim: int,
                     backend_check: bool = True) -> bool:
     """True when the whole-S kernel should handle this (S, H*hd) shape by
     default: TPU backend, sequence short enough for in-VMEM scores, packed
