@@ -122,16 +122,25 @@ _MIN_DELTA_S = 0.05
 # tunnel). EDGELLM_PROBE_ALL=1 adds the separate encode/decode split.
 
 
-def _timed_scan(build_body, pool_tree, pool: int, lengths=None) -> float:
-    """Seconds per iteration of ``build_body`` applied to pool entry
-    ``i % pool`` (leading axis of every ``pool_tree`` leaf = pool). One element
-    of every output leaf is folded into the carry so nothing is DCE'd; the
-    loop-carried index defeats hoisting. Differential over two scan lengths
-    cancels the axon tunnel's fixed per-call cost."""
-    import jax
-    import jax.numpy as jnp
+class _ScanTimer:
+    """Differential-scan timer for one body, caching the compiled scan
+    executables per length so REPEATED measurements (the interleaved-pair
+    medians) cost readbacks, not retrace+recompile."""
 
-    def make_run(length):
+    def __init__(self, build_body, pool_tree, pool: int):
+        self.build_body = build_body
+        self.pool_tree = pool_tree
+        self.pool = pool
+        self._runs: dict = {}
+
+    def _run_for(self, length):
+        import jax
+        import jax.numpy as jnp
+
+        if length in self._runs:
+            return self._runs[length]
+        build_body, pool = self.build_body, self.pool
+
         @jax.jit
         def run(tree):
             def body(carry, idx):
@@ -151,28 +160,39 @@ def _timed_scan(build_body, pool_tree, pool: int, lengths=None) -> float:
                                     jnp.arange(length) % pool)
             return carry
 
+        self._runs[length] = run
         return run
 
-    def rep_of(run, reps=2):
-        float(run(pool_tree))  # compile + warm
+    def _rep_of(self, run, reps=2):
+        float(run(self.pool_tree))  # compile + warm
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            float(run(pool_tree))  # forced readback (axon)
+            float(run(self.pool_tree))  # forced readback (axon)
             ts.append(time.perf_counter() - t0)
         return min(ts)
 
-    n1, n2 = lengths or (_N1, _N2)
-    for _ in range(3):
-        t1 = rep_of(make_run(n1))
-        t2 = rep_of(make_run(n2))
-        delta, span = t2 - t1, n2 - n1
-        if delta >= _MIN_DELTA_S:
-            return delta / span
-        n1, n2 = n1 * 4, n2 * 4  # too fast to resolve: quadruple the work
-    # still inside the jitter band after escalating: NaN, never a rate made
-    # of noise (callers omit the affected fields)
-    return float("nan")
+    def differential(self, lengths=None) -> float:
+        n1, n2 = lengths or (_N1, _N2)
+        for _ in range(3):
+            t1 = self._rep_of(self._run_for(n1))
+            t2 = self._rep_of(self._run_for(n2))
+            delta, span = t2 - t1, n2 - n1
+            if delta >= _MIN_DELTA_S:
+                return delta / span
+            n1, n2 = n1 * 4, n2 * 4  # too fast to resolve: quadruple the work
+        # still inside the jitter band after escalating: NaN, never a rate
+        # made of noise (callers omit the affected fields)
+        return float("nan")
+
+
+def _timed_scan(build_body, pool_tree, pool: int, lengths=None) -> float:
+    """Seconds per iteration of ``build_body`` applied to pool entry
+    ``i % pool`` (leading axis of every ``pool_tree`` leaf = pool). One element
+    of every output leaf is folded into the carry so nothing is DCE'd; the
+    loop-carried index defeats hoisting. Differential over two scan lengths
+    cancels the axon tunnel's fixed per-call cost."""
+    return _ScanTimer(build_body, pool_tree, pool).differential(lengths)
 
 
 def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
@@ -250,14 +270,17 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
         """(median pallas time, median per-pair jnp/pallas ratio); the jnp
         side of a pair is only timed when the pallas differential resolved
         (escalating scans for a value that could never be emitted are the
-        probe's biggest time sink)."""
+        probe's biggest time sink). One _ScanTimer per side: the compiled
+        scan executables are built once and every further rep is readbacks."""
+        timer_p = _ScanTimer(make_p, tree, pool)
+        timer_j = _ScanTimer(make_j, tree, pool)
         tps, ratios = [], []
         for _ in range(reps):
-            tp = _timed_scan(make_p, tree, pool)
+            tp = timer_p.differential()
             if not math.isfinite(tp):
                 continue
             tps.append(tp)
-            tj = _timed_scan(make_j, tree, pool)
+            tj = timer_j.differential()
             if math.isfinite(tj):
                 ratios.append(tj / tp)
         return (statistics.median(tps) if tps else float("nan"),
