@@ -187,21 +187,26 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
         return out, stats
 
     from .flash_attention import (causal_attention, causal_attention_stats,
-                                  kernel_eligible)
+                                  kernel_plan)
 
-    use_kernel = kernel_eligible(s, h * hd)
+    attn_plan = kernel_plan(s, h, kv, hd,
+                            itemsize=jnp.dtype(x.dtype).itemsize)
+    use_kernel = attn_plan is not None
     if not capture_stats:
         # Hot path. On TPU at S <= 1024 the whole-S Pallas kernel (one
         # (batch, head) score matrix per grid step, entirely in VMEM) measures
         # ~2.4x XLA's fused attention at the flagship's hd=64 shapes and
-        # ~3.4x at qwen2-1.5b's hd=128 (models/flash_attention.py); wider or
-        # longer shapes use XLA's fused path (flash-style schedule, no O(S^2)
-        # HBM probs, native GQA). This is the analogue of the reference's
+        # ~3.4x at qwen2-1.5b's hd=128; longer sequences (S=2048, the
+        # reference's own Pythia window) and wider rows (llama-1b) take the
+        # query-blocked / head-group-split kernel (models/flash_attention.py);
+        # shapes outside both envelopes use XLA's fused path (flash-style
+        # schedule, no O(S^2) HBM probs, native GQA). This is the analogue of
+        # the reference's
         # SDPA instance for quantized forwards (pythia_model.py:25) while the
         # stats branch below replaces its second, eager-attention model
         # (last_row_exp.py:68).
         if use_kernel:
-            return project_out(causal_attention(q, k, v), None)
+            return project_out(causal_attention(q, k, v, plan=attn_plan), None)
         return project_out(
             jax.nn.dot_product_attention(q, k, v, is_causal=True), None)
 
@@ -209,7 +214,7 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
         # fused stats capture: col_sum and last_row read directly off the
         # in-VMEM probability matrix (the blocked-scan path below stays as
         # the portable implementation and, at stats_block=0, the oracle)
-        out, stats = causal_attention_stats(q, k, v)
+        out, stats = causal_attention_stats(q, k, v, plan=attn_plan)
         return project_out(out, stats)
 
     rep = h // kv

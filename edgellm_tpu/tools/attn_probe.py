@@ -1,0 +1,118 @@
+"""Silicon probe for the attention kernels: Pallas (whole-S / blocked) vs
+XLA's fused ``jax.nn.dot_product_attention`` at the model shapes the sweeps
+actually run.
+
+Mirrors the codec probe's phase-robust estimator (``pallas_probe``): each
+variant is timed with the differential scan, measurements are taken in
+interleaved (pallas, xla) pairs, and the reported speedup is the median of
+per-pair ratios — immune to the axon tunnel's slow phase drift, which once
+read the same codec at 1.4x and 0.75x in back-to-back sequential runs.
+
+Reference workload being covered: both Pythia experiments evaluate at
+window = 2048 (``Experiments/Pythia-70M/initial_exp.py:86``,
+``last_row_exp.py:72-74``) — the shape that motivated the blocked kernel.
+"""
+from __future__ import annotations
+
+import json
+from statistics import median
+
+import numpy as np
+
+from .pallas_probe import _ScanTimer
+
+#: (name, batch, heads, kv_heads, seq, head_dim) — the sweep shapes:
+#: pythia window-2048 (reference's own evaluation window), the flagship ring
+#: config's full-sequence shape, llama-1b at the standard window, and the
+#: two whole-S shapes already validated in round 4 (regression guards).
+SHAPES = [
+    ("pythia-70m_s2048", 8, 8, 8, 2048, 64),
+    ("qwen2-0.5b_s2048", 8, 14, 2, 2048, 64),
+    ("llama-3.2-1b_s512", 32, 32, 8, 512, 64),
+    ("qwen2-0.5b_s512", 64, 14, 2, 512, 64),
+    ("qwen2-1.5b_s512", 32, 12, 2, 512, 128),
+]
+
+
+def probe_shape(name: str, b: int, h: int, kv: int, s: int, hd: int,
+                *, pool: int = 2, reps: int = 3, stats: bool = False,
+                seed: int = 0) -> dict:
+    """Time kernel vs XLA attention at one shape -> result dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import flash_attention as fa
+
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(pool, b, s, h, hd)), jnp.bfloat16)
+    k = jnp.asarray(rng.normal(size=(pool, b, s, kv, hd)), jnp.bfloat16)
+    v = jnp.asarray(rng.normal(size=(pool, b, s, kv, hd)), jnp.bfloat16)
+    tree = (q, k, v)
+
+    plan = fa._shape_plan(s, h, kv, hd)
+    if plan is None:
+        return {"shape": name, "plan": None}
+
+    if stats:
+        def pallas_body(x):
+            out, st = fa.causal_attention_stats(*x, interpret=False, plan=plan)
+            return (out, *st)
+    else:
+        def pallas_body(x):
+            return fa.causal_attention(*x, interpret=False, plan=plan)
+
+    def xla_body(x):
+        return jax.nn.dot_product_attention(*x, is_causal=True)
+
+    import math
+
+    tp = _ScanTimer(pallas_body, tree, pool)
+    tx = _ScanTimer(xla_body, tree, pool)
+    # drop pairs with an unresolved (NaN) differential, exactly like the
+    # codec probe's paired_medians — a median over NaNs is undefined and a
+    # NaN field would make the bench sidecar spec-invalid JSON
+    pairs = [(p, x) for p, x in
+             ((tp.differential(), tx.differential()) for _ in range(reps))
+             if math.isfinite(p) and math.isfinite(x)]
+    result = {"shape": name, "dims": [b, h, kv, s, hd], "plan": list(plan),
+              "stats": stats}
+    if not pairs:  # every rep stayed inside the jitter band: no rate fields
+        return result
+    p_s = median(p for p, _ in pairs)
+    x_s = median(x for _, x in pairs)
+    ratio = median(x / p for p, x in pairs)
+    # full-square accounting (the kernels compute and mask the causal upper
+    # triangle — measured faster than any skip; see flash_attention.py)
+    flops = 4.0 * b * h * s * s * hd
+    result.update({
+        "pallas_us": round(p_s * 1e6, 1), "xla_us": round(x_s * 1e6, 1),
+        "pallas_tflops": round(flops / p_s / 1e12, 1),
+        "xla_tflops": round(flops / x_s / 1e12, 1),
+        "speedup_vs_xla": round(ratio, 2),
+    })
+    return result
+
+
+def probe_all(*, stats: bool = False, shapes=None) -> list[dict]:
+    out = []
+    for args in (shapes or SHAPES):
+        out.append(probe_shape(*args, stats=stats))
+        print(json.dumps(out[-1]), flush=True)
+    return out
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--stats", action="store_true",
+                    help="time the stats-capture variants instead")
+    ap.add_argument("--shape", default=None,
+                    help="probe only the named shape")
+    a = ap.parse_args()
+    shapes = [t for t in SHAPES if a.shape is None or t[0] == a.shape]
+    probe_all(stats=a.stats, shapes=shapes)
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,9 @@ import jax.numpy as jnp
 
 from edgellm_tpu.models.flash_attention import (causal_attention,
                                                 causal_attention_stats,
-                                                kernel_eligible)
+                                                kernel_eligible,
+                                                kernel_plan,
+                                                _shape_plan)
 
 
 def _dense(q, k, v):
@@ -61,7 +63,9 @@ def test_model_attention_same_under_either_backend(rng, monkeypatch):
     from edgellm_tpu.models import tiny_config, init_params
     from edgellm_tpu.models.transformer import forward, run_layers_from_ids
 
-    cfg = tiny_config("qwen2", num_layers=3, hidden_size=64, num_heads=4,
+    # hd must be in VALIDATED_HD (64) or the pallas force would silently take
+    # the XLA path and this test would compare XLA against XLA
+    cfg = tiny_config("qwen2", num_layers=3, hidden_size=256, num_heads=4,
                       vocab_size=128)
     params = init_params(cfg, jax.random.key(0))
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 32)))
@@ -88,9 +92,57 @@ def test_kernel_eligibility(monkeypatch):
     # CPU default: no kernel (interpret mode would be slow, XLA is fine)
     assert not kernel_eligible(512, 896)
     monkeypatch.setenv("EDGELLM_ATTN", "pallas")
-    assert kernel_eligible(512, 896)
-    assert kernel_eligible(512, 1536)   # qwen2-1.5b: measured 3.4x win
-    assert not kernel_eligible(2048, 896)  # whole-S scores would blow VMEM
-    assert not kernel_eligible(512, 2048)  # llama-1b row: scoped-VMEM OOM
+    assert kernel_plan(512, 14, 2, 64) == ("whole", None)   # flagship
+    assert kernel_plan(512, 12, 2, 128) == ("whole", None)  # qwen2-1.5b
+    # S=2048 — the reference's Pythia window: query-blocked kernel
+    assert kernel_plan(2048, 8, 8, 64) == ("blocked", (512, 8))
+    assert kernel_plan(2048, 14, 2, 64) == ("blocked", (512, 14))
+    # llama-1b: packed row 2048 > whole-kernel envelope -> head-group split
+    assert kernel_plan(512, 32, 8, 64) == ("blocked", (512, 16))
+    assert kernel_plan(2048, 32, 8, 64) == ("blocked", (512, 16))
+    # beyond the blocked envelope, unvalidated hd, ragged GQA: XLA
+    assert kernel_plan(4096, 8, 8, 64) is None
+    assert kernel_plan(512, 8, 8, 80) is None      # ADVICE r4: hd gate
+    assert kernel_plan(512, 14, 4, 64) is None     # H % KV != 0
+    assert kernel_plan(1536, 8, 8, 64) == ("blocked", (512, 8))
+    assert kernel_plan(1100, 8, 8, 64) is None     # S not qb-aligned
     monkeypatch.setenv("EDGELLM_ATTN", "xla")
     assert not kernel_eligible(512, 896)
+
+
+@pytest.mark.parametrize("b,h,kv,s,hd,qb,hps", [
+    (2, 4, 4, 128, 32, 32, 4),   # query-blocked, all heads per step
+    (2, 4, 2, 128, 32, 64, 2),   # query-blocked + GQA head-group split
+    (1, 8, 2, 64, 32, 64, 4),    # head-group split only (qb == S)
+    (2, 4, 4, 96, 16, 32, 2),    # both splits, MHA
+])
+def test_blocked_kernel_matches_dense(rng, b, h, kv, s, hd, qb, hps):
+    q = rng.normal(size=(b, s, h, hd)).astype(np.float32)
+    k = rng.normal(size=(b, s, kv, hd)).astype(np.float32)
+    v = rng.normal(size=(b, s, kv, hd)).astype(np.float32)
+    want, p = _dense(q, k, v)
+    plan = ("blocked", (qb, hps))
+    got = causal_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           interpret=True, plan=plan)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5, rtol=2e-5)
+    out, (col, last) = causal_attention_stats(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        interpret=True, plan=plan)
+    np.testing.assert_allclose(np.asarray(out), want, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(col), p.sum(axis=2) / s, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(last), p[:, :, -1, :], atol=1e-6)
+
+
+def test_blocked_plan_is_auto_resolved(rng):
+    """At a shape outside the whole-S envelope, causal_attention resolves the
+    blocked plan itself (what the model's TPU dispatch relies on)."""
+    assert _shape_plan(128, 4, 2, 32) == ("whole", None)
+    b, s, h, kv, hd = 1, 1536, 4, 2, 32
+    # force the blocked path by shape: s > MAX_WHOLE_S
+    q = rng.normal(size=(b, s, h, hd)).astype(np.float32)
+    k = rng.normal(size=(b, s, kv, hd)).astype(np.float32)
+    v = rng.normal(size=(b, s, kv, hd)).astype(np.float32)
+    want, _ = _dense(q, k, v)
+    got = causal_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                           interpret=True)
+    np.testing.assert_allclose(np.asarray(got), want, atol=2e-5, rtol=2e-5)
