@@ -69,9 +69,12 @@ def parse_hop_codec(spec: str, n_seq: int = 1) -> object:
         raise ValueError(f"selective mode {mode!r} only applies to the "
                          f"stage x seq runtime (n_seq > 1)")
     if parts[0].endswith("_pallas"):
-        from ..codecs.pallas_kernels import pallas_selective_int4
+        from ..codecs.pallas_kernels import SELECTIVE_EXCLUSION
 
-        return pallas_selective_int4(ratio, high)
+        # the kernel twin was DELETED round 5 on measurement; honoring the
+        # pin silently with the jnp codec would misreport what ran
+        raise ValueError(f"'selective_int4_pallas' no longer exists: "
+                         f"{SELECTIVE_EXCLUSION}")
     return selective_int4(ratio, high)
 
 

@@ -54,13 +54,15 @@ def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
 def apply_default_codec_backend(codecs: list) -> list:
     """Resolve hop-codec specs (names or ``WireCodec`` instances) to the
     backend's default implementation. On TPU the fused Pallas kernels are the
-    default — but only where the kernel is a MEASURED on-silicon win
-    (``pallas_kernels.PALLAS_DEFAULT_WINS``; the probe showed int8_per_channel
-    and the selective core marginally slower than their already-fused jnp
-    twins, so those stay on XLA by default). EDGELLM_PALLAS forces
-    substitution of every kernel twin (=1) or none (=0) on any backend;
-    explicit ``*_pallas`` names are always honored. Shared by every runtime
-    that owns hop codecs."""
+    default — but only where the kernel is a MEASURED on-silicon win for
+    this chip (``pallas_kernels.default_substituted``: the probe cache keyed
+    by chip fingerprint, with ``PALLAS_DEFAULT_WINS`` as the no-data
+    fallback; the probe showed int8_per_channel marginally slower than its
+    already-fused jnp twin, and the selective codec's twin was deleted
+    outright on measurement — ``SELECTIVE_EXCLUSION``). EDGELLM_PALLAS
+    forces substitution of every kernel twin (=1) or none (=0) on any
+    backend; explicit ``*_pallas`` names are always honored. Shared by every
+    runtime that owns hop codecs."""
     codecs = [c if isinstance(c, WireCodec) else get_wire_codec(c) for c in codecs]
     flag = os.environ.get("EDGELLM_PALLAS")
     if flag == "1":

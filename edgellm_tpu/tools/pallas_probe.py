@@ -44,7 +44,11 @@ from typing import Optional
 
 import numpy as np
 
-#: codec names (registry names / selective spec) — every auto-substituted pair.
+#: codec names (registry names) — every codec with a kernel twin. The
+#: selective codec is NOT here: its twin was deleted in round 5 on
+#: measurement (gather-bound; the pallas boundary broke XLA's gather->quant
+#: fusion and probed 0.96-0.97x across rounds) — probe_all() appends the
+#: recorded exclusion so the decision stays in every bench artifact.
 PROBE_CODECS = (
     "int4_per_token",
     "int8_per_token",
@@ -52,18 +56,13 @@ PROBE_CODECS = (
     "int4_per_channel",
     "ternary_mean",
     "ternary_max",
-    "selective_int4_r0.5_bf16",
 )
 
 
 def _codec_pair(name: str):
-    from edgellm_tpu.codecs.packing import get_wire_codec, selective_int4
-    from edgellm_tpu.codecs.pallas_kernels import pallas_selective_int4, pallas_variant
+    from edgellm_tpu.codecs.packing import get_wire_codec
+    from edgellm_tpu.codecs.pallas_kernels import pallas_variant
 
-    if name.startswith("selective_int4_r"):
-        ratio_str, high = name[len("selective_int4_r"):].rsplit("_", 1)
-        return selective_int4(float(ratio_str), high), \
-            pallas_selective_int4(float(ratio_str), high)
     jnp_codec = get_wire_codec(name)
     return jnp_codec, pallas_variant(jnp_codec)
 
@@ -218,16 +217,18 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
     dec_ulp = _ulp_diff(dec_got, dec_want)
     assert dec_ulp <= max_ulp, f"{name} decode: {dec_ulp} ulp > {max_ulp}"
 
-    from edgellm_tpu.codecs.pallas_kernels import PALLAS_DEFAULT_WINS
+    from edgellm_tpu.codecs.pallas_kernels import default_substituted
+    from edgellm_tpu.codecs.probe_cache import base_name
 
     result = {
         "codec": name,
         "backend": jax.default_backend(),
         "shape": [batch, seq, dim],
         # whether the TPU default path substitutes this kernel (the measured-
-        # win policy, split.apply_default_codec_backend); non-default twins
+        # win policy: this chip's probe cache, frozen set as no-data
+        # fallback; split.apply_default_codec_backend); non-default twins
         # stay probed for parity and remain pinnable via *_pallas names
-        "default_substituted": name in PALLAS_DEFAULT_WINS,
+        "default_substituted": default_substituted(base_name(name)),
         "int_leaves_bit_identical": n_int,
         "encode_max_ulp": enc_ulp,
         "decode_max_ulp": dec_ulp,
@@ -345,6 +346,33 @@ def probe_all(*, timing: Optional[bool] = None, batch: int = 8, seq: int = 512,
         codecs.append(probe_codec(
             name, batch=batch, seq=seq, dim=dim, pool=pool,
             timing=timing, timing_detail=timing and detail))
+    from edgellm_tpu.codecs.pallas_kernels import SELECTIVE_EXCLUSION
+
+    codecs.append({
+        "codec": "selective_int4",
+        "default_substituted": False,
+        "excluded": SELECTIVE_EXCLUSION,
+        # the measurements the deletion decision rests on (v5e, r4/r5)
+        "measured": {"roundtrip_speedup_vs_jnp_r4": 0.97,
+                     "roundtrip_speedup_vs_jnp_r5": 0.96,
+                     "encode_speedup_vs_jnp_r5": 0.97,
+                     "decode_speedup_vs_jnp_r5": 0.99},
+    })
+    cache_path = None
+    if timing:
+        # persist this run's measured speedups as THE substitution policy for
+        # this chip (codecs/probe_cache.py), then re-annotate each block with
+        # the post-record policy: what the NEXT sweep on this chip will
+        # substitute, derived from measurement, never a stale constant
+        from edgellm_tpu.codecs.pallas_kernels import default_substituted
+        from edgellm_tpu.codecs.probe_cache import base_name, record
+
+        cache_path = record(codecs)
+        if cache_path:
+            for c in codecs:
+                if "excluded" not in c:  # deleted twins stay excluded
+                    c["default_substituted"] = default_substituted(
+                        base_name(c["codec"]))
     return {
         "backend": jax.default_backend(),
         "interpret": not on_tpu,
@@ -353,6 +381,7 @@ def probe_all(*, timing: Optional[bool] = None, batch: int = 8, seq: int = 512,
         "timing": None if not timing else (
             "roundtrip per codec" + (" + encode/decode split" if detail else
                                      " (EDGELLM_PROBE_ALL=1 adds the split)")),
+        "probe_cache": cache_path,
         "codecs": codecs,
     }
 

@@ -7,8 +7,8 @@ import jax.numpy as jnp
 
 from edgellm_tpu.codecs.packing import get_wire_codec, selective_int4
 from edgellm_tpu.codecs.pallas_kernels import (
-    int4_encode_pallas, int4_decode_pallas, pallas_wire_codec,
-    pallas_int8_per_token, pallas_ternary, pallas_selective_int4, pallas_variant,
+    SELECTIVE_EXCLUSION, int4_encode_pallas, int4_decode_pallas,
+    pallas_wire_codec, pallas_int8_per_token, pallas_ternary, pallas_variant,
 )
 
 
@@ -73,19 +73,19 @@ def test_pallas_twins_bit_identical(hidden, name):
                                np.asarray(jnp_codec.decode(want)), atol=1e-6)
 
 
-@pytest.mark.parametrize("ratio", [0.0, 0.25, 0.5, 1.0])
-def test_pallas_selective_bit_identical(hidden, rng, ratio):
-    imp = jnp.asarray(rng.random(hidden.shape[1]).astype(np.float32))
-    jnp_codec = selective_int4(ratio, "bf16")
-    pallas_codec = pallas_selective_int4(ratio, "bf16")
-    want = jnp_codec.encode(hidden, imp)
-    got = pallas_codec.encode(hidden, imp)
-    _assert_payload_equal(got, want)
-    np.testing.assert_allclose(np.asarray(pallas_codec.decode(got)),
-                               np.asarray(jnp_codec.decode(want)), atol=1e-6)
-    # the variant dispatcher recovers (ratio, high) from the codec name
-    via_variant = pallas_variant(jnp_codec)
-    assert via_variant.name == jnp_codec.name + "_pallas"
+def test_selective_has_no_kernel_twin_by_measurement():
+    """The selective codec's Pallas twin was DELETED in round 5 on silicon
+    measurement (gather-bound; the kernel boundary broke XLA's gather->quant
+    fusion, 0.96-0.97x across rounds). The exclusion is a recorded decision:
+    pallas_variant returns None on every path and the runtimes fall back to
+    the jnp codec, which IS the TPU-native implementation."""
+    import edgellm_tpu.codecs.pallas_kernels as pk
+
+    jnp_codec = selective_int4(0.5, "bf16")
+    assert pallas_variant(jnp_codec) is None
+    assert pallas_variant(jnp_codec, measured_wins_only=True) is None
+    assert not hasattr(pk, "pallas_selective_int4")
+    assert "gather-bound" in SELECTIVE_EXCLUSION
 
 
 def test_registry_exposes_pallas_names():
@@ -117,16 +117,19 @@ def test_split_runtime_substitutes_pallas_when_forced(rng, monkeypatch):
                                atol=1e-6, rtol=1e-6)
 
 
-def test_default_substitution_is_gated_on_measured_wins(monkeypatch):
-    """The TPU default path substitutes only kernels the probe measured as
-    wins; int8_per_channel (0.94x) and the selective core (0.97x) stay on
-    their jnp twins unless EDGELLM_PALLAS=1 forces every twin. Explicit
-    *_pallas pins are always honored."""
+def test_default_substitution_is_gated_on_measured_wins(monkeypatch, tmp_path):
+    """The TPU default path substitutes only kernels measured as wins for
+    this chip (probe cache, frozen set as no-data fallback); int8_per_channel
+    (0.94x) stays jnp, the selective twin no longer exists at all, and
+    EDGELLM_PALLAS=1 forces every REMAINING twin. Explicit *_pallas pins are
+    always honored."""
     import jax
     from edgellm_tpu.codecs.packing import selective_int4
     from edgellm_tpu.parallel.split import apply_default_codec_backend
 
     monkeypatch.delenv("EDGELLM_PALLAS", raising=False)
+    # point the policy at an empty cache: the frozen fallback set decides
+    monkeypatch.setenv("EDGELLM_PROBE_CACHE", str(tmp_path / "none.json"))
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
     out = apply_default_codec_backend(
         ["int4_per_token", "int8_per_token", selective_int4(0.5, "bf16"),
@@ -134,15 +137,16 @@ def test_default_substitution_is_gated_on_measured_wins(monkeypatch):
     assert [c.name for c in out] == [
         "int4_per_token_pallas",       # measured win (1.33x) -> substituted
         "int8_per_token",              # 0.80x -> stays jnp
-        "selective_int4_r0.5_bf16",    # 0.97x core -> stays jnp
+        "selective_int4_r0.5_bf16",    # twin deleted on measurement
         "int8_per_channel_pallas",     # explicit pin honored
     ]
 
     monkeypatch.setenv("EDGELLM_PALLAS", "1")
     forced = apply_default_codec_backend(
         ["int8_per_channel", selective_int4(0.5, "bf16")])
+    # even forced substitution cannot resurrect a deleted twin
     assert [c.name for c in forced] == [
-        "int8_per_channel_pallas", "selective_int4_r0.5_bf16_pallas"]
+        "int8_per_channel_pallas", "selective_int4_r0.5_bf16"]
 
 
 def test_pallas_codec_in_split_runtime(rng):

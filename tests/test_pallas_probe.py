@@ -20,8 +20,13 @@ def test_ulp_diff():
 def test_probe_all_parity_small():
     out = probe_all(timing=False, batch=2, seq=32, dim=64)
     assert out["interpret"] is True
-    assert [c["codec"] for c in out["codecs"]] == list(PROBE_CODECS)
-    for c in out["codecs"]:
+    # every kernel-twinned codec, plus the recorded selective exclusion (the
+    # measured round-5 deletion travels in every probe artifact)
+    assert [c["codec"] for c in out["codecs"]] == \
+        list(PROBE_CODECS) + ["selective_int4"]
+    assert "gather-bound" in out["codecs"][-1]["excluded"]
+    assert not out["codecs"][-1]["default_substituted"]
+    for c in out["codecs"][:-1]:
         assert c["encode_max_ulp"] <= 2 and c["decode_max_ulp"] <= 2
         assert c["int_leaves_bit_identical"] >= 1
         # timing disabled off-chip

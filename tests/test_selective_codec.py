@@ -130,19 +130,14 @@ def test_per_row_payload_counts_batched_order():
     assert four == B * (one - 4) + B * 4
 
 
-def test_pallas_per_row_matches_jnp(rng):
-    from edgellm_tpu.codecs.pallas_kernels import pallas_selective_int4
+def test_selective_pallas_pin_is_a_clear_error():
+    """The selective kernel twin was deleted on measurement (round 5); an
+    explicit 'selective_int4_pallas' split-eval spec must fail loudly with
+    the recorded reason, never silently run something else."""
+    from edgellm_tpu.eval.split_eval import parse_hop_codec
 
-    h = jnp.asarray(rng.normal(size=(3, 16, 32)).astype(np.float32))
-    imp = jnp.asarray(rng.random((3, 16)).astype(np.float32))
-    j = selective_int4(0.5, "bf16")
-    pc = pallas_selective_int4(0.5, "bf16")
-    want, got = j.encode(h, imp), pc.encode(h, imp)
-    for key in want:
-        np.testing.assert_array_equal(np.asarray(got[key]), np.asarray(want[key]),
-                                      err_msg=key)
-    np.testing.assert_allclose(np.asarray(pc.decode(got)),
-                               np.asarray(j.decode(want)), atol=1e-6)
+    with pytest.raises(ValueError, match="gather-bound"):
+        parse_hop_codec("selective_int4_pallas:0.5:bf16", n_seq=1)
 
 
 def test_split_runtime_per_row_importance_data_parallel(rng):
