@@ -121,9 +121,10 @@ def _apply_token_codec(codec: str, hidden, importance, ratio, k):
 
 @functools.lru_cache(maxsize=None)
 def _stats_forward(cfg: ModelConfig, hidden_layers: tuple = None,
-                   tail: Optional[int] = None,
+                   want_final: bool = False,
                    stats_upto: Optional[int] = None):
-    """Jitted prefix pass: ids -> (attention stats, boundary hiddens[, NLL]).
+    """Jitted prefix pass: ids -> (attention stats, boundary hiddens[, final
+    hidden]).
 
     Specialized to what the sweep consumes (round 4 — the original pass
     captured stats and stacked hiddens for every layer, most never read):
@@ -136,20 +137,24 @@ def _stats_forward(cfg: ModelConfig, hidden_layers: tuple = None,
       (L, W, S, D) stack was 1.4 GB of HBM writes per 64-window flagship
       group), returned stacked in sorted-layer order — index via
       ``sorted(set(hidden_layers)).index(layer)``;
-    - with ``tail`` set, the layers past ``stats_upto`` run WITHOUT stats
-      capture and the final hidden is tail-scored: the returned per-window
-      NLL IS the method-independent ratio-0 fp baseline, replacing the old
-      separate baseline executable (a second full suffix forward
-      per group). With ``tail=None`` those layers never run at all.
+    - with ``want_final``, the layers past ``stats_upto`` run WITHOUT stats
+      capture and the FINAL hidden is returned; the caller tail-scores it
+      with :func:`_base_tail` into the method-independent ratio-0 fp
+      baseline, replacing the old separate baseline executable (a second
+      full suffix forward per group). The tail length lives in that thin
+      scorer, NOT here — so the full-depth stats executable compiles once
+      per layer set while only the small unembed tail recompiles per
+      distinct scoring-tail length (ADVICE r4). With ``want_final=False``
+      those layers never run at all.
 
     ``hidden_layers=None`` keeps the original full-depth behavior (all
-    layers' stats + hiddens; no baseline).
+    layers' stats + hiddens; no final hidden).
     """
     from ..models.transformer import embed
 
     if hidden_layers is None:
         @jax.jit
-        def full(params, ids, targets=None):
+        def full(params, ids):
             _, aux = run_layers_from_ids(cfg, params, ids, capture_stats=True)
             return aux["stats"], aux["hiddens"], None
 
@@ -161,7 +166,7 @@ def _stats_forward(cfg: ModelConfig, hidden_layers: tuple = None,
     upto = max(stats_upto if stats_upto is not None else 0, layers[-1])
 
     @jax.jit
-    def fn(params, ids, targets=None):
+    def fn(params, ids):
         h = embed(params, ids)
         cols, lasts, hiddens = [], [], []
         prev = 0
@@ -181,11 +186,22 @@ def _stats_forward(cfg: ModelConfig, hidden_layers: tuple = None,
         stats = AttnStats(
             col_mean=jnp.concatenate(cols) if len(cols) > 1 else cols[0],
             last_row=jnp.concatenate(lasts) if len(lasts) > 1 else lasts[0])
-        base = None
-        if tail is not None:
-            out, _ = run_layers(cfg, params, h, start=prev)
-            base = nll_tail(cfg, params, out, targets, tail, per_example=True)
-        return stats, jnp.stack(hiddens), base
+        final = None
+        if want_final:
+            final, _ = run_layers(cfg, params, h, start=prev)
+        return stats, jnp.stack(hiddens), final
+
+    return fn
+
+
+@functools.lru_cache(maxsize=None)
+def _base_tail(cfg: ModelConfig, tail: int):
+    """Thin per-tail scorer over the stats forward's returned final hidden:
+    only this unembed tail recompiles per distinct scoring-tail length, the
+    full-depth stats executable stays tail-independent (ADVICE r4)."""
+    @jax.jit
+    def fn(params, final, targets):
+        return nll_tail(cfg, params, final, targets, tail, per_example=True)
 
     return fn
 
@@ -249,13 +265,21 @@ DEDUP_ZERO_CODECS = ("int4_token_select", "affine_int8_rank")
 def _suffix_sweep(cfg: ModelConfig, layer: int, codec: str, tail: int):
     """Jitted: boundary hiddens at ``layer`` -> (ratio, window) NLL matrix.
 
-    Two nested vmaps: the reference's batched-over-ratios intent
-    (``pythia_model.py:36-54``, one batch row per ratio) plus a window-batch
-    axis, so W evaluation windows x R ratios run as ONE batched suffix
-    executable. Per-window codec scales are preserved (the reference quantizes
-    each window independently at batch 1). The full-vocab unembed runs only on
-    the ``tail`` scoring positions (``nll_tail``) — exact, because everything
-    earlier is masked to -100 by the windowing recipe.
+    The codec step keeps the reference's batched-over-ratios intent
+    (``pythia_model.py:36-54``, one batch row per ratio) as a vmap over
+    (ratio, window) — per-window codec scales are preserved (the reference
+    quantizes each window independently at batch 1) — but the suffix forward
+    and scoring tail then run UNVMAPPED on the flattened (R*W, S, D) batch.
+    Numerically identical (layers and tail are ratio-independent; each row
+    still scores alone), and measured faster on the v5e (round 5): the
+    nested-vmap version carried 5-D [R, W, 1, S, D] activations whose
+    non-default layouts forced a ~117 MB physical-no-op copy on each side of
+    every attention custom-call and a per-vocab-block logits retile copy in
+    the streamed unembed (~0.48 ms per block — as much as the block's matmul
+    itself); the flat batch keeps every tensor in default layout. The
+    full-vocab unembed runs only on the ``tail`` scoring positions
+    (``nll_tail``) — exact, because everything earlier is masked to -100 by
+    the windowing recipe.
 
     boundary_hidden (W, S, D), targets (W, S), importance (W, S), ratios (R,)
     -> (R, W).
@@ -263,15 +287,20 @@ def _suffix_sweep(cfg: ModelConfig, layer: int, codec: str, tail: int):
 
     @jax.jit
     def fn(params, boundary_hidden, targets, importance, ratios, ks):
+        w, s, d = boundary_hidden.shape
+        r = ratios.shape[0]
+
         def per_ratio(ratio, k):
-            def per_window(h_w, tgt_w, imp_w):
-                h = _apply_token_codec(codec, h_w[None], imp_w, ratio, k)
-                out, _ = run_layers(cfg, params, h, start=layer + 1)
-                return nll_tail(cfg, params, out, tgt_w[None], tail)
+            def per_window(h_w, imp_w):
+                return _apply_token_codec(codec, h_w[None], imp_w, ratio, k)[0]
 
-            return jax.vmap(per_window)(boundary_hidden, targets, importance)
+            return jax.vmap(per_window)(boundary_hidden, importance)
 
-        return jax.vmap(per_ratio)(ratios, ks)
+        h = jax.vmap(per_ratio)(ratios, ks).reshape(r * w, s, d)
+        out, _ = run_layers(cfg, params, h, start=layer + 1)
+        tgt = jnp.broadcast_to(targets[None], (r, w, s)).reshape(r * w, s)
+        nll = nll_tail(cfg, params, out, tgt, tail, per_example=True)
+        return nll.reshape(r, w)
 
     return fn
 
@@ -286,12 +315,12 @@ def _suffix_channel(cfg: ModelConfig, layer: int, method: str, tail: int):
 
     @jax.jit
     def fn(params, boundary_hidden, targets):  # (W, S, D), (W, S) -> (W,)
-        def per_window(h_w, tgt_w):
-            h = channel_wise_quant(h_w[None], method)
-            out, _ = run_layers(cfg, params, h, start=layer + 1)
-            return nll_tail(cfg, params, out, tgt_w[None], tail)
-
-        return jax.vmap(per_window)(boundary_hidden, targets)
+        h = jax.vmap(lambda h_w: channel_wise_quant(h_w[None], method)[0])(
+            boundary_hidden)
+        # flat-batch suffix + tail (same 5-D-layout-copy reasoning as
+        # _suffix_sweep; identical values — rows score independently)
+        out, _ = run_layers(cfg, params, h, start=layer + 1)
+        return nll_tail(cfg, params, out, targets, tail, per_example=True)
 
     return fn
 
@@ -657,9 +686,13 @@ def run_token_sweep(
         # int(ratio * s) (qwen_layer_wise.py:57) and the wire codecs
         ks = jnp.asarray([int(float(ratios[i]) * ids.shape[1]) for i in nz_idx],
                          jnp.int32)
-        stats_fn = _stats_forward(cfg, layer_key,
-                                  tail if zero_idx else None)
-        stats, hiddens, base = stats_fn(params, ids, targets)
+        stats_fn = _stats_forward(cfg, layer_key, want_final=bool(zero_idx))
+        stats, hiddens, final = stats_fn(params, ids)
+        base = _base_tail(cfg, tail)(params, final, targets) if zero_idx else None
+        # drop the (W, S, D) final-hidden buffer BEFORE the suffix loop: the
+        # tail scorer has consumed it, and keeping it alive would add ~59 MB
+        # (flagship shape) the preflight's suffix-phase model doesn't budget
+        del final
         imp_all = imp_fn(stats, hw)  # (M, L', W, S), one device call
         pending = []  # (m_indices, l, ratio_indices, device_nlls)
         for l, layer in enumerate(layers_of_interest):
@@ -732,7 +765,7 @@ def run_initial_sweep(
     # aggregations, and "upto ratio"'s quant-layer distribution
     n_stats = max([quant_layer, 2] + [int(l) for l in layers_of_interest
                                       if l not in magic]) + 1
-    stats_fn = _stats_forward(cfg, (quant_layer,), None, stats_upto=n_stats - 1)
+    stats_fn = _stats_forward(cfg, (quant_layer,), stats_upto=n_stats - 1)
 
     def submit(ids, targets, tail):
         ks = jnp.asarray([int(0.1 * r * ids.shape[1]) for r in ratios], jnp.int32)

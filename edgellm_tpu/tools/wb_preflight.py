@@ -81,9 +81,9 @@ def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
         return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
                    + ma.temp_size_in_bytes)
 
-    stats_tail = tail if codec in DEDUP_ZERO_CODECS else None
-    stats = call_bytes(_stats_forward(cfg, layers, stats_tail)
-                       .lower(params_shape, ids, targets))
+    want_final = codec in DEDUP_ZERO_CODECS
+    stats = call_bytes(_stats_forward(cfg, layers, want_final=want_final)
+                       .lower(params_shape, ids))
 
     hidden = jax.ShapeDtypeStruct((W, S, D), dtype)
     imp = jax.ShapeDtypeStruct((W, S), jnp.float32)
@@ -91,9 +91,19 @@ def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
     ks = jax.ShapeDtypeStruct((n_ratios,), jnp.int32)
     suffix = call_bytes(_suffix_sweep(cfg, layer, codec, tail)
                         .lower(params_shape, hidden, targets, imp, ratios, ks))
+    base = 0
+    if want_final:
+        # the baseline tail scorer is a THIRD executable since round 5 split
+        # it out of the stats forward (_base_tail): its streamed-unembed
+        # temps must be in the estimate too, or the preflight approves a
+        # batch that OOMs at the baseline-scoring call
+        from ..eval.harness import _base_tail
 
-    if stats is None or suffix is None:  # compiler-proven over-HBM
-        return {"stats_call": stats, "suffix_call": suffix,
+        base = call_bytes(_base_tail(cfg, tail)
+                          .lower(params_shape, hidden, targets))
+
+    if stats is None or suffix is None or base is None:  # proven over-HBM
+        return {"stats_call": stats, "suffix_call": suffix, "base_call": base,
                 "hiddens_stack": 0, "peak": float("inf")}
     itemsize = jnp.dtype(dtype).itemsize
     hiddens_stack = n_interest * W * S * D * itemsize  # collected boundaries
@@ -102,8 +112,9 @@ def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
     # the suffix sees one (W,S,D) slice as an arg while BOTH groups' full
     # stacks are alive (submit/drain double buffering)
     peak = max(stats + hiddens_stack,  # stats call + previous group's stack
-               suffix + 2 * hiddens_stack + 2 * stats_buf)
-    return {"stats_call": stats, "suffix_call": suffix,
+               suffix + 2 * hiddens_stack + 2 * stats_buf,
+               base + 2 * hiddens_stack + 2 * stats_buf)
+    return {"stats_call": stats, "suffix_call": suffix, "base_call": base,
             "hiddens_stack": hiddens_stack, "peak": peak}
 
 

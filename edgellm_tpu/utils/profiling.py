@@ -82,20 +82,24 @@ def measure_peak_tflops(sizes=(4096, 6144), pool: int = 4,
         # MEDIAN of the sane attempts: a single differential can land +-15%
         # on the tunnel (round-4 observed 184-240 TF/s for the same chip),
         # and the MFU-vs-measured ratio is only as honest as this denominator.
-        # ``cap`` (the spec-sheet peak) rejects provably-impossible samples:
-        # a chip cannot beat its own spec, so a supra-spec differential means
-        # the timing underestimated, never that the chip overdelivered.
-        hi = min(2000.0, cap * 1.05) if cap else 2000.0
+        # The sanity band is PHYSICAL (no accelerator does 2000 bf16 TF/s),
+        # deliberately NOT the ``cap`` env knob: banding on the knob would
+        # reject every honest sample on a chip faster than the configured
+        # spec and leave the denominator knob-bound — the median already
+        # rejects a single noise outlier inside the physical band.
         vals = []
         for _ in range(attempts):
             t = _timed_scan(
                 lambda b_mat: jnp.dot(a, b_mat, preferred_element_type=jnp.float32),
                 bs, pool, lengths=(32, 256))
             tflops = 2.0 * n ** 3 / t / 1e12
-            if 10.0 < tflops < hi:
+            if 10.0 < tflops < 2000.0:
                 vals.append(tflops)
         if vals:
             import statistics
 
             best = max(best or 0.0, statistics.median(vals))
-    return min(best, cap) if (best and cap) else best
+    # the returned value is the measurement itself — neither clamped to nor
+    # banded by the ``cap`` env knob (kept for API compatibility; a knob
+    # that disagrees with the hardware must not shape the MFU denominator).
+    return best
