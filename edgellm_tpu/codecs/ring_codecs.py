@@ -28,7 +28,13 @@ be agreed across sequence shards. Two variants, both running INSIDE
   sizes equal the dense codec's per-token bytes exactly; the selected SET may
   differ from the dense global argsort (it is the per-shard restriction of a
   rank-balanced selection), so PPL is close to but not bit-equal with the
-  dense path.
+  dense path. MEASURED accuracy cost at the flagship ring shape
+  (``tools/ring_mode_gap.py``: qwen2-0.5b, cut 11, S=2048, n_seq=4,
+  ``configs/split5b_qwen_ring_selective.json``): |dNLL vs mode="global"|
+  <= 8.4e-4 at ratio 0.25 and <= 1.6e-3 at ratio 0.5 — two orders of
+  magnitude below the reference's own PPL deltas between adjacent ratios.
+  ``tests/test_ring_codecs.py`` asserts a 0.02 bound; ``dryrun_multichip``
+  records the local-vs-global |dNLL| in every round's MULTICHIP artifact.
 
 Both accept shared ``(S_loc,)`` or per-row ``(B, S_loc)`` LOCAL importance
 shards, mirroring the dense codec's wire format rules.
@@ -54,11 +60,17 @@ class RingWireCodec(WireCodec):
 
     ring_axis: str = "seq"
     n_seq: int = 1
-    #: (full_hidden_shape, dtype) -> total payload bytes across all shards
+    #: (full_hidden_shape, per_row) -> total payload bytes across all shards
     payload_bytes_fn: object = None
 
-    def payload_bytes(self, hidden_shape, dtype=jnp.float32) -> int:
-        return int(self.payload_bytes_fn(hidden_shape))
+    def payload_bytes(self, hidden_shape, dtype=jnp.float32,
+                      per_row: bool = True) -> int:
+        """``per_row`` picks the wire format being accounted: per-row (B, S)
+        importance carries a (B,) scale and (B, c_low) int16 indices per
+        shard; shared (S,) importance carries a (1,) scale and (c_low,)
+        indices. ``SplitRingRuntime`` forces per-row whenever batch > 1, so
+        the default matches what actually crosses the hop."""
+        return int(self.payload_bytes_fn(hidden_shape, per_row))
 
 
 _HIGH_DTYPES = {"fp32": jnp.float32, "bf16": jnp.bfloat16, "fp16": jnp.float16}
@@ -165,24 +177,29 @@ def ring_selective_int4(ratio: float, high: str = "bf16", *, n_seq: int,
 
     local_base = selective_int4(ratio, high, scale_fn=ring_scale)
 
-    def payload_bytes_fn(hidden_shape):
+    def payload_bytes_fn(hidden_shape, per_row=True):
         """Total bytes across all n_seq shard payloads for one full (B, S, D)
-        boundary activation (what actually crosses the stage hop)."""
+        boundary activation (what actually crosses the stage hop). The scale
+        and index side channels follow the wire format: per-row importance
+        ships a (B,) scale + (B, c_low) int16 indices, shared importance a
+        (1,) scale + (c_low,) indices (ADVICE r4 — the old accounting
+        assumed per-row for both)."""
         b, s, d = hidden_shape
         s_loc = s // n_seq
+        rows = b if per_row else 1
         if mode == "global":
             k = int(ratio * s)
             c_low = min(s_loc, k)
             per_shard = (b * c_low * (d // 2)       # packed int4 capacity
                          + b * s_loc * d * high_bytes  # in-place high buffer
-                         + b * c_low * 2            # int16 local indices
-                         + b * 4)                   # per-row fp32 scale
+                         + rows * c_low * 2         # int16 local indices
+                         + rows * 4)                # fp32 scale
         else:
             k_loc = int(ratio * s_loc)
             per_shard = (b * k_loc * (d // 2)
                          + b * (s_loc - k_loc) * d * high_bytes
-                         + b * k_loc * 2
-                         + b * 4)
+                         + rows * k_loc * 2
+                         + rows * 4)
         return n_seq * per_shard
 
     enc = encode_global if mode == "global" else local_base.encode

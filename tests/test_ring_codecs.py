@@ -142,20 +142,25 @@ def test_ring_selective_local_runs_and_is_wire_optimal(setup):
 
 
 @pytest.mark.parametrize("mode", ["global", "local"])
-def test_ring_payload_accounting_matches_buffers(setup, mode):
+@pytest.mark.parametrize("per_row", [True, False])
+def test_ring_payload_accounting_matches_buffers(setup, mode, per_row):
     """The analytic payload_bytes equals the actual bytes of the per-shard
-    encode buffers (summed over shards)."""
+    encode buffers (summed over shards) — for BOTH wire formats: per-row
+    (B, S) importance and shared (S,) importance, whose scale/index side
+    channels are batch-independent (ADVICE r4)."""
     params, ids = setup
     b, s, d = 2, 32, CFG.hidden_size
     n_seq = 4
     codec = ring_selective_int4(0.25, "bf16", n_seq=n_seq, mode=mode)
     h = jnp.asarray(np.random.default_rng(5).normal(size=(b, s, d)),
                     jnp.float32)
-    imp = jnp.asarray(np.random.default_rng(6).random((b, s)), jnp.float32)
+    imp_shape = (b, s) if per_row else (s,)
+    imp = jnp.asarray(np.random.default_rng(6).random(imp_shape), jnp.float32)
     mesh = make_seq_mesh(n_seq)
+    imp_spec = P(None, "seq") if per_row else P("seq")
     payload = shard_map(
         codec.encode, mesh=mesh,
-        in_specs=(P(None, "seq"), P(None, "seq")),
+        in_specs=(P(None, "seq"), imp_spec),
         # concatenating every leaf over the ring axis makes the global leaf
         # sizes the sum of the per-shard payload sizes
         out_specs=jax.tree_util.tree_map(lambda _: P("seq"),
@@ -166,7 +171,7 @@ def test_ring_payload_accounting_matches_buffers(setup, mode):
     )(h, imp)
     actual = sum(np.asarray(v).nbytes for v in
                  jax.tree_util.tree_leaves(payload))
-    assert actual == codec.payload_bytes((b, s, d))
+    assert actual == codec.payload_bytes((b, s, d), per_row=per_row)
 
 
 def test_split_eval_ring_selective_equals_plain(setup, tmp_path):
@@ -199,8 +204,14 @@ def test_split_eval_ring_selective_local_mode(setup):
                          hop_codecs=("selective_int4:0.25:bf16:local",), **kw)
     assert np.isfinite(loc["ppl"])
     assert loc["hop_codecs"] == ["ring_selective_int4_r0.25_bf16_local"]
-    # different selection set, same compression: PPLs close but not equal
-    np.testing.assert_allclose(loc["ppl"], glob["ppl"], rtol=0.1)
+    # different selection set, same compression: PPLs close but not equal.
+    # The asserted |dNLL| bound (0.02) is >10x the worst value measured at
+    # the flagship ring shape — qwen2-0.5b / cut 11 / S=2048 / n_seq=4 gave
+    # |dNLL| <= 8.4e-4 (ratio 0.25) and <= 1.6e-3 (ratio 0.5); see
+    # tools/ring_mode_gap.py and the MULTICHIP artifact's
+    # ring_selective_local entry
+    d_nll = abs(float(np.log(loc["ppl"])) - float(np.log(glob["ppl"])))
+    assert d_nll <= 0.02, d_nll
     assert loc["bytes_per_token_per_hop"][0] < glob["bytes_per_token_per_hop"][0]
 
 
