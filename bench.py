@@ -68,7 +68,12 @@ def main():
     dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
         os.environ.get("BENCH_DTYPE", "bfloat16")]
 
-    max_length, stride = 512, 32
+    # BENCH_MAX_LENGTH=2048 reproduces the reference's own Pythia evaluation
+    # window (Experiments/Pythia-70M/initial_exp.py:86 — both Pythia
+    # experiments evaluate at window = max_position_embeddings = 2048),
+    # served by the round-5 query-blocked attention kernel
+    max_length = int(os.environ.get("BENCH_MAX_LENGTH", "512"))
+    stride = int(os.environ.get("BENCH_STRIDE", "32"))
     methods = ["regular_importance", "weighted_importance", "last_row", "aggregate_till"]
     # the reference's headline split layer (11) where it exists; mid-stack for
     # shallower presets so any BENCH_MODEL runs
@@ -145,11 +150,16 @@ def main():
     tflops_per_s = chunk_flops / s_per_chunk / 1e12
 
     line = {
-        "metric": f"{model_name} sweep time per 32-token chunk (4 methods x 1 layer x 5 ratios)",
+        "metric": (f"{model_name} sweep time per {stride}-token chunk "
+                   f"(4 methods x 1 layer x 5 ratios, window {max_length})"),
         "value": round(s_per_chunk, 4),
         "unit": "s/chunk",
+        # the 16 s/chunk anchor is the reference's Qwen2-0.5B run at ITS
+        # workload shape (window 512, stride 32) — other models or windows
+        # have no anchor to compare against
         "vs_baseline": (round(REFERENCE_S_PER_CHUNK / s_per_chunk, 2)
-                        if model_name == "qwen2-0.5b" else None),
+                        if (model_name, max_length, stride) ==
+                        ("qwen2-0.5b", 512, 32) else None),
         "tokens_per_s": round(stride / s_per_chunk, 1),
         "window_batch": window_batch,
         "model_tflops_per_s": round(tflops_per_s, 2),
@@ -219,7 +229,9 @@ def main():
                                  stats=rel_stats, **rel_kw)
         line["relevance_it_per_s"] = round(rel_stats["it_per_s"], 2)
         detail["relevance_window_batch"] = rel_wb
-        if model_name == "qwen2-0.5b":  # the 2.1 it/s anchor is this workload
+        # the 2.1 it/s anchor is the reference's Qwen2-0.5B relevance run at
+        # ITS workload shape — same guard as vs_baseline above
+        if (model_name, max_length, stride) == ("qwen2-0.5b", 512, 32):
             line["relevance_vs_baseline"] = round(rel_stats["it_per_s"] / 2.1, 2)
 
     # on-silicon proof of the Pallas codec substitution path (VERDICT r2 #1):

@@ -20,23 +20,16 @@ ratios (BASELINE.md). The bound asserted in ``tests/test_ring_codecs.py``
 from __future__ import annotations
 
 import json
-import os
-import re
 
 
 def measure(model: str = "qwen2-0.5b", seq: int = 2048, n_seq: int = 4,
             cut: int = 11, ratios=(0.25, 0.5), windows: int = 2,
             seed: int = 0) -> list[dict]:
-    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   os.environ.get("XLA_FLAGS", ""))
-    os.environ["XLA_FLAGS"] = (
-        flags + f" --xla_force_host_platform_device_count={2 * n_seq}").strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    from ..utils.spoof import spoof_cpu_devices
+
+    spoof_cpu_devices(2 * n_seq)
 
     import jax
-
-    jax.config.update("jax_platforms", "cpu")
-
     import numpy as np
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -50,6 +43,16 @@ def measure(model: str = "qwen2-0.5b", seq: int = 2048, n_seq: int = 4,
     params = init_params(cfg, jax.random.key(seed), dtype=jnp.bfloat16)
     mesh = Mesh(np.asarray(jax.devices()[:2 * n_seq]).reshape(2, n_seq),
                 ("stage", "seq"))
+    # one runtime per (ratio, mode), HOISTED out of the window loop: each
+    # SplitRingRuntime owns its own jitted closure, so rebuilding per window
+    # would re-trace and re-compile the full 24-layer S=2048 graph
+    runtimes = {
+        (ratio, mode): SplitRingRuntime(
+            cfg, (cut,),
+            (ring_selective_int4(ratio, "bf16", n_seq=n_seq, mode=mode),),
+            mesh)
+        for ratio in ratios for mode in ("global", "local")}
+    placed = {key: rt.place_params(params) for key, rt in runtimes.items()}
     rng = np.random.default_rng(seed)
     out = []
     for w in range(windows):
@@ -58,11 +61,8 @@ def measure(model: str = "qwen2-0.5b", seq: int = 2048, n_seq: int = 4,
         for ratio in ratios:
             nll = {}
             for mode in ("global", "local"):
-                rt = SplitRingRuntime(
-                    cfg, (cut,),
-                    (ring_selective_int4(ratio, "bf16", n_seq=n_seq,
-                                         mode=mode),), mesh)
-                logits = rt.forward(rt.place_params(params), ids,
+                rt = runtimes[(ratio, mode)]
+                logits = rt.forward(placed[(ratio, mode)], ids,
                                     hop_importance=[imp])
                 nll[mode] = float(nll_from_logits(logits, ids))
             rec = {"window": w, "ratio": ratio, "nll_global": nll["global"],
