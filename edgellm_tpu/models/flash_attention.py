@@ -198,6 +198,14 @@ def _head_attn(q, k, v, row0=0):
 def _attn_packed_kernel(q_ref, k_ref, v_ref, o_ref, *, hd):
     """Grid (B,): one batch row, every head, PACKED (S, H*hd) q/out layout.
 
+    NOTE: the whole-S family (this kernel + its stats twin) is the qb=S,
+    hps=H special case of the blocked family below — any fix to masking,
+    dtype casting, or stats capture must land in BOTH. They stay separate
+    until a silicon probe confirms the blocked kernel's 3-D grid costs
+    nothing at the validated whole-S shapes (the round-4 measurements that
+    earned this kernel were taken on the 1-D grid; collapsing without that
+    probe would silently re-litigate them).
+
     The packed layout is the natural shape of the QKV projection output, so
     the (B, S, H, hd) -> (B, H, S, hd) transpose of q and of the output —
     hundreds of MB each way per layer at the sweep's 256-row batches — never
