@@ -34,6 +34,11 @@ extraction throughput, reference anchor 2.1 it/s), BENCH_REL_CHUNKS
 down to fit, default 16), BENCH_HBM_GB (device memory for the window-batch
 preflight, default 15.75).
 
+BENCH_DECODE=1 switches the bench to the KV-cached incremental decode
+workload instead of the sweep (see ``decode_main``): headline unit becomes
+``decode tokens/s``, with the per-step split-boundary hop bytes/token in the
+detail sidecar. The stdout contract is identical.
+
 An over-large BENCH_WINDOW_BATCH never kills the bench: on TPU an AOT
 memory-analysis preflight (tools/wb_preflight.py) halves it to the largest
 batch whose estimated peak fits BEFORE anything runs (a real TPU OOM would
@@ -50,7 +55,134 @@ import numpy as np
 REFERENCE_S_PER_CHUNK = 16.0  # qwen2-0.5B_experiment.ipynb cell 12 (BASELINE.md)
 
 
+def _emit(line: dict, detail: dict) -> None:
+    """The stdout/sidecar contract shared by every bench mode: verbose detail
+    to an atomic sidecar + an earlier {"detail": ...} line, compact headline
+    JSON as the FINAL line (the driver's tail capture truncates giant lines)."""
+    detail_path = os.environ.get("BENCH_DETAIL_PATH", "BENCH_DETAIL.json")
+    try:
+        # the harness's atomic tmp+rename writer: never a half-written sidecar
+        from edgellm_tpu.eval.harness import _save_checkpoint_state
+
+        _save_checkpoint_state(detail_path, detail)
+    except OSError as e:
+        import sys
+
+        print(f"bench: could not write {detail_path}: {e}", file=sys.stderr)
+    print(json.dumps({"detail": detail}))
+    print(json.dumps(line))
+
+
+def decode_main():
+    """BENCH_DECODE=1: KV-cached incremental decode throughput (tokens/s).
+
+    One prefill + N decode_step calls per pass via serve.generate; the
+    headline value is the best sustained decode tokens/s over BENCH_REPEATS
+    passes (same phase-drift rationale as the sweep's best-of-N). Knobs:
+    BENCH_DECODE_PROMPT (prompt tokens, default 128), BENCH_DECODE_TOKENS
+    (new tokens per row, default 128), BENCH_DECODE_BATCH (default 8),
+    BENCH_DECODE_CODEC (split-boundary wire codec accounted in the detail
+    sidecar, default int8_per_token), BENCH_DECODE_SPLIT=1 (additionally run
+    the 2-stage pipeline-split decode when >= 2 devices are visible and
+    record its measured hop bytes/token), plus the shared BENCH_MODEL,
+    BENCH_DTYPE and BENCH_REPEATS."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.serve.decode import generate
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    prompt = int(os.environ.get("BENCH_DECODE_PROMPT", "128"))
+    new_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "128"))
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", "8"))
+    repeats = max(int(os.environ.get("BENCH_REPEATS", "2")), 1)
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    codec_name = os.environ.get("BENCH_DECODE_CODEC", "int8_per_token")
+    capacity = prompt + new_tokens
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt)))
+
+    warm: dict = {}
+    generate(cfg, params, ids, new_tokens, capacity=capacity,
+             compute_dtype=dtype, stats=warm)  # compile prefill + step
+    passes = []
+    prefill_s = []
+    for _ in range(repeats):
+        st: dict = {}
+        generate(cfg, params, ids, new_tokens, capacity=capacity,
+                 compute_dtype=dtype, stats=st)
+        passes.append(st["decode_tokens_per_s"])
+        prefill_s.append(st["prefill_s"])
+    tokens_per_s = max(passes)  # full precision; rounded only for display
+
+    # what a split deployment would move per decode step at this batch: the
+    # (B, 1, D) boundary activation through the configured wire codec
+    from edgellm_tpu.codecs.packing import get_wire_codec
+
+    codec = get_wire_codec(codec_name)
+    hop_bytes_per_token = codec.payload_bytes((batch, 1, cfg.hidden_size)) / batch
+
+    detail = {
+        "decode": {
+            "prompt": prompt, "new_tokens": new_tokens, "batch": batch,
+            "capacity": capacity,
+            "passes_tokens_per_s": [round(p, 2) for p in passes],
+            "prefill_s": [round(p, 4) for p in prefill_s],
+            "decode_step_cache_misses_warm": warm["decode_step_cache_misses"],
+            "split_hop_codec": codec_name,
+            "split_hop_bytes_per_token": hop_bytes_per_token,
+        },
+    }
+
+    if (os.environ.get("BENCH_DECODE_SPLIT", "0") == "1"
+            and len(jax.devices()) >= 2):
+        from edgellm_tpu.parallel.split import (SplitConfig, SplitRuntime,
+                                                make_stage_mesh)
+
+        cut = cfg.num_layers // 2 - 1
+        rt = SplitRuntime(cfg, SplitConfig(cuts=(cut,),
+                                           hop_codecs=(codec_name,)),
+                          make_stage_mesh(2))
+        placed = rt.place_params(params)
+        logits, cache = rt.prefill_decode(placed, ids, capacity)
+        tok = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        logits, cache = rt.decode_step(placed, cache, tok)  # compile step
+        jax.block_until_ready(logits)
+        t0 = time.monotonic()
+        for _ in range(new_tokens - 1):
+            tok = jnp.argmax(logits, -1).astype(jnp.int32)
+            logits, cache = rt.decode_step(placed, cache, tok)
+        jax.block_until_ready(logits)
+        split_s = time.monotonic() - t0
+        detail["decode"]["split"] = {
+            "cut": cut,
+            "tokens_per_s": round(batch * (new_tokens - 1) / split_s, 2),
+            "measured_hop_bytes_per_step": rt.decode_hop_bytes(batch),
+            "hop_bytes_per_token": [b / batch
+                                    for b in rt.decode_hop_bytes(batch)],
+        }
+
+    line = {
+        "metric": (f"{model_name} greedy decode throughput "
+                   f"(prompt {prompt} +{new_tokens} tokens, batch {batch})"),
+        "value": round(tokens_per_s, 1),
+        "unit": "decode tokens/s",
+        "vs_baseline": None,  # the reference has no autoregressive workload
+        "tokens_per_s": round(tokens_per_s, 1),
+        "prefill_s": round(min(prefill_s), 4),
+        "batch": batch,
+        "decode_step_cache_misses": warm["decode_step_cache_misses"],
+    }
+    _emit(line, detail)
+
+
 def main():
+    if os.environ.get("BENCH_DECODE") == "1":
+        return decode_main()
     import jax
     import jax.numpy as jnp
     from edgellm_tpu.models import PRESETS, init_params
@@ -249,21 +381,13 @@ def main():
 
         names = os.environ.get(
             "BENCH_ATTN_SHAPES", "pythia-70m_s2048,llama-3.2-1b_s512").split(",")
-        detail["attn_kernel"] = [probe_shape(*t, reps=2)
+        # reps >= 3: the interleaved-pair estimator is a MEDIAN of per-pair
+        # ratios — at reps=2 it degenerates to a midpoint and the phase-drift
+        # rejection it exists for never engages (ADVICE r5 #4)
+        detail["attn_kernel"] = [probe_shape(*t, reps=3)
                                  for t in SHAPES if t[0] in names]
 
-    detail_path = os.environ.get("BENCH_DETAIL_PATH", "BENCH_DETAIL.json")
-    try:
-        # the harness's atomic tmp+rename writer: never a half-written sidecar
-        from edgellm_tpu.eval.harness import _save_checkpoint_state
-
-        _save_checkpoint_state(detail_path, detail)
-    except OSError as e:
-        import sys
-
-        print(f"bench: could not write {detail_path}: {e}", file=sys.stderr)
-    print(json.dumps({"detail": detail}))
-    print(json.dumps(line))
+    _emit(line, detail)
 
 
 if __name__ == "__main__":

@@ -84,7 +84,11 @@ def record(results, fp: Optional[str] = None) -> Optional[str]:
     policy then simply stays on the fallback constant."""
     speedups = {}
     for r in results:
-        s = r.get("roundtrip_speedup_vs_jnp")
+        # prefer the probe's unrounded ratio: WIN_MARGIN is a hysteresis
+        # threshold and must never see a 1.045 reading pre-rounded to 1.05
+        # (the rounded field stays for display and as back-compat fallback)
+        s = r.get("roundtrip_speedup_vs_jnp_raw",
+                  r.get("roundtrip_speedup_vs_jnp"))
         if isinstance(s, (int, float)) and math.isfinite(s):
             speedups[base_name(r["codec"])] = float(s)
     if not speedups:

@@ -2,7 +2,7 @@ from .configs import (ModelConfig, PYTHIA_70M, QWEN2_0_5B, QWEN2_1_5B,
                       LLAMA_3_2_1B, PRESETS, tiny_config)
 from .transformer import (
     AttnStats, forward, run_layers, embed, unembed, nll_from_logits, init_params,
-    precompute_rope,
+    precompute_rope, KVCache, init_cache, prefill, decode_step,
 )
 from .hf_loader import params_from_state_dict, config_from_hf
 
@@ -11,4 +11,5 @@ __all__ = [
     "PRESETS", "tiny_config",
     "AttnStats", "forward", "run_layers", "embed", "unembed", "nll_from_logits",
     "init_params", "precompute_rope", "params_from_state_dict", "config_from_hf",
+    "KVCache", "init_cache", "prefill", "decode_step",
 ]

@@ -121,7 +121,13 @@ def _shape_plan(s: int, h: int, kv: int, hd: int, itemsize: int = 2):
     if h % kv:
         raise ValueError(f"kernels need head-aligned GQA, got H={h}, KV={kv}")
     dh = h * hd
-    if s <= MAX_WHOLE_S and dh <= MAX_PACKED_DH:
+    # the whole-S envelope constants were validated at bf16; wider activation
+    # dtypes double the resident score/probs and packed-row bytes, so the
+    # eligibility window shrinks with itemsize (ADVICE r5 #1) — shapes that
+    # fall out land on the blocked branch, whose hps search already budgets
+    # the resident K/V blocks by itemsize
+    scale = max(itemsize, 2) // 2
+    if s <= MAX_WHOLE_S // scale and dh <= MAX_PACKED_DH // scale:
         return ("whole", None)
     if s > MAX_BLOCKED_S:
         return None
@@ -163,8 +169,19 @@ def kernel_eligible(seq: int, model_dim: int,
                     num_heads: int | None = None,
                     num_kv_heads: int | None = None) -> bool:
     """True when a Pallas kernel handles this (S, H*hd) shape by default.
-    Head layout defaults to the flagship's hd=64 MHA split when not given."""
+
+    Callers must pass the real head layout: the historical hd=64 MHA
+    inference is DEPRECATED (ADVICE r5 #2) because it disagrees with real
+    dispatch for hd=128 and GQA presets — real dispatch is
+    :func:`kernel_plan` on (S, H, KV, hd)."""
     if num_heads is None:
+        import warnings
+
+        warnings.warn(
+            "kernel_eligible without num_heads/num_kv_heads infers an hd=64 "
+            "MHA layout, which can disagree with real dispatch for hd=128/GQA "
+            "presets; pass the head counts or use kernel_plan directly",
+            DeprecationWarning, stacklevel=2)
         num_heads = max(model_dim // 64, 1)
     if num_kv_heads is None:
         num_kv_heads = num_heads
@@ -441,3 +458,66 @@ def causal_attention_stats(q, k, v, *, interpret: bool | None = None,
         out, col, last = _attn_blocked_stats(q2, kt, vt, hd, args[0], args[1],
                                              interpret)
     return out.reshape(b, s, h, hd), (col, last)
+
+
+# ---------------------------------------------------------------------------
+# Decode attention: q_len=1 against a length-masked KV cache.
+# ---------------------------------------------------------------------------
+
+
+def decode_plan(capacity: int, h: int, kv: int, hd: int,
+                itemsize: int = 2):
+    """Kernel plan for the q_len=1 decode shape — mirrors :func:`kernel_plan`
+    so the probe-cache substitution policy carries over unchanged once a
+    decode kernel is validated on silicon. Today it always returns ``None``:
+    one query row leaves the MXU idle and the step is HBM-bound on the K/V
+    cache read, a regime where XLA's fused dot-product path is already at the
+    bandwidth roofline — there is no measured win to encode, and an
+    unvalidated kernel must not dispatch by default (the same rule
+    ``VALIDATED_HD`` enforces for the prefill kernels). Callers treat the
+    return exactly like :func:`kernel_plan`'s, so a future validated plan
+    slots in without touching the dispatch site."""
+    if os.environ.get("EDGELLM_ATTN") == "xla":
+        return None
+    if hd not in VALIDATED_HD or h % kv:
+        return None
+    return None  # no decode kernel validated yet: XLA fallback for all shapes
+
+
+def decode_attention(q, k_cache, v_cache, length):
+    """Single-position attention against a cache: q (B, 1, H, hd) vs
+    k/v_cache (B, capacity, KV, hd) of which the first ``length`` positions
+    are valid (``length`` is traced — one executable per capacity).
+    Returns (B, 1, H, hd) in q's dtype; softmax in fp32.
+
+    GQA broadcasting happens here, not in the cache: the per-group einsum
+    reads each KV head once and applies it to its ``rep`` query heads, so
+    the cache stays at num_kv_heads width (the whole point of GQA at decode
+    time — the cache read IS the bottleneck).
+    """
+    b, s1, h, hd = q.shape
+    kv = k_cache.shape[2]
+    rep = h // kv
+    if s1 != 1:
+        raise ValueError(f"decode_attention is q_len=1 only, got q_len={s1}")
+    if h % kv:
+        raise ValueError(f"ragged GQA: H={h}, KV={kv}")
+    # consult the kernel plan exactly like the prefill dispatch does; None for
+    # every shape today (no validated decode kernel), so the XLA fallback
+    # below is the only implementation
+    plan = decode_plan(k_cache.shape[1], h, kv, hd,
+                       itemsize=jnp.dtype(q.dtype).itemsize)
+    assert plan is None
+    # head j*rep+g attends KV group j — the same packing convention as the
+    # prefill kernels' column slices (c0 = (j*rep+g)*hd)
+    qg = q[:, 0].reshape(b, kv, rep, hd)
+    scores = jnp.einsum("bgrd,bcgd->bgrc", qg, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / np.sqrt(hd))
+    valid = jnp.arange(k_cache.shape[1]) < length  # (capacity,)
+    scores = jnp.where(valid[None, None, None, :], scores,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bgrc,bcgd->bgrd", probs.astype(q.dtype), v_cache,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return out.reshape(b, 1, h, hd)

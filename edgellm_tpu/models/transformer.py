@@ -138,7 +138,8 @@ def _stats_block_size(s: int, requested: Optional[int]) -> int:
 def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
               capture_stats: bool,
               tp_axis: Optional[str] = None,
-              stats_block: Optional[int] = None) -> tuple[jnp.ndarray, Optional[tuple]]:
+              stats_block: Optional[int] = None,
+              return_kv: bool = False):
     """Eager-math attention (explicit softmax) with optional reduced-stat capture.
 
     The explicit-softmax formulation is what lets importance statistics fall out of
@@ -175,6 +176,9 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
 
     q = apply_rotary(q, cos, sin, cfg.rotary_dim)
     k = apply_rotary(k, cos, sin, cfg.rotary_dim)
+    # the cacheable K/V: post-rotary, PRE-GQA-repeat (the cache stores
+    # num_kv_heads — decode_attention re-broadcasts per query group)
+    cache_kv = (k, v) if return_kv else None
 
     def project_out(out, stats):
         """The shared output epilogue: row-split projection, tp reduction,
@@ -184,7 +188,7 @@ def attention(cfg: ModelConfig, lp: dict, x: jnp.ndarray, cos, sin,
             out = jax.lax.psum(out, tp_axis)
         if "bo" in lp:
             out = out + lp["bo"]
-        return out, stats
+        return (out, stats, cache_kv) if return_kv else (out, stats)
 
     from .flash_attention import (causal_attention, causal_attention_stats,
                                   kernel_plan)
@@ -284,20 +288,26 @@ def mlp(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
 def block(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray, cos, sin,
           capture_stats: bool,
           tp_axis: Optional[str] = None,
-          stats_block: Optional[int] = None) -> tuple[jnp.ndarray, Optional[tuple]]:
-    """One decoder block. GPT-NeoX: parallel residual; Qwen2: sequential."""
+          stats_block: Optional[int] = None,
+          return_kv: bool = False):
+    """One decoder block. GPT-NeoX: parallel residual; Qwen2: sequential.
+    With ``return_kv`` the post-rotary per-layer K/V ride along (the prefill
+    path fills the decode cache from them); returns (hidden, stats[, (k, v)]).
+    """
     if cfg.family == "gpt_neox":
         attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
-        attn_out, stats = attention(cfg, lp, attn_in, cos, sin, capture_stats,
-                                    tp_axis, stats_block)
+        attn_out, stats, *kv = attention(cfg, lp, attn_in, cos, sin, capture_stats,
+                                         tp_axis, stats_block, return_kv)
         mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
-        return hidden + attn_out + mlp(cfg, lp, mlp_in, tp_axis), stats
+        out = hidden + attn_out + mlp(cfg, lp, mlp_in, tp_axis)
+        return (out, stats, kv[0]) if return_kv else (out, stats)
     attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
-    attn_out, stats = attention(cfg, lp, attn_in, cos, sin, capture_stats,
-                                tp_axis, stats_block)
+    attn_out, stats, *kv = attention(cfg, lp, attn_in, cos, sin, capture_stats,
+                                     tp_axis, stats_block, return_kv)
     hidden = hidden + attn_out
     mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
-    return hidden + mlp(cfg, lp, mlp_in, tp_axis), stats
+    out = hidden + mlp(cfg, lp, mlp_in, tp_axis)
+    return (out, stats, kv[0]) if return_kv else (out, stats)
 
 
 def embed(params: dict, input_ids: jnp.ndarray) -> jnp.ndarray:
@@ -320,6 +330,7 @@ def run_layers(cfg: ModelConfig, params: dict, hidden: jnp.ndarray, *,
                boundary_fn: Optional[Callable] = None,
                capture_stats: bool = False,
                collect_hidden: bool = False,
+               collect_kv: bool = False,
                stats_block: Optional[int] = None):
     """Run decoder layers [start, stop) over ``hidden`` via one lax.scan.
 
@@ -328,7 +339,9 @@ def run_layers(cfg: ModelConfig, params: dict, hidden: jnp.ndarray, *,
     interception point as the reference's ``if i == layer_of_interest`` edit
     (``qwen_layer_wise.py:54``), but jit-safe.
 
-    Returns (hidden, aux) where aux holds optional per-layer stats/hiddens.
+    Returns (hidden, aux) where aux holds optional per-layer stats/hiddens and,
+    with ``collect_kv``, the stacked post-rotary K/V the decode cache is
+    prefilled from (aux["kv"] = (k, v), each (L, B, S, KV, hd)).
     """
     stop = cfg.num_layers if stop is None else stop
     if not (0 <= start <= stop <= cfg.num_layers):
@@ -341,19 +354,22 @@ def run_layers(cfg: ModelConfig, params: dict, hidden: jnp.ndarray, *,
 
     def body(h, xs):
         lp, idx = xs
-        h, stats = block(cfg, lp, h, cos, sin, capture_stats,
-                         stats_block=stats_block)
+        h, stats, *kv = block(cfg, lp, h, cos, sin, capture_stats,
+                              stats_block=stats_block, return_kv=collect_kv)
         if boundary_fn is not None:
             h = boundary_fn(idx, h)
-        out = (stats if capture_stats else None, h if collect_hidden else None)
+        out = (stats if capture_stats else None, h if collect_hidden else None,
+               kv[0] if collect_kv else None)
         return h, out
 
-    hidden, (stats, hiddens) = jax.lax.scan(body, hidden, (layer_stack, idxs))
+    hidden, (stats, hiddens, kvs) = jax.lax.scan(body, hidden, (layer_stack, idxs))
     aux = {}
     if capture_stats:
         aux["stats"] = AttnStats(col_mean=stats[0], last_row=stats[1])
     if collect_hidden:
         aux["hiddens"] = hiddens  # (L, B, S, D), post-boundary_fn
+    if collect_kv:
+        aux["kv"] = kvs  # ((L, B, S, KV, hd), (L, B, S, KV, hd))
     return hidden, aux
 
 
@@ -406,6 +422,157 @@ def run_layers_from_ids(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray, 
     hidden = embed(params, input_ids)
     return run_layers(cfg, params, hidden, capture_stats=capture_stats,
                       collect_hidden=True, stats_block=stats_block)
+
+
+# ---------------------------------------------------------------------------
+# KV-cached incremental decode: prefill fills the cache for the prompt, then
+# decode_step appends ONE position per call — O(1) work per emitted token
+# instead of the O(S) full re-forward the evaluation entry points do.
+# ---------------------------------------------------------------------------
+
+
+class KVCache(NamedTuple):
+    """Per-layer key/value cache for incremental decode.
+
+    k, v: (L, B, capacity, KV, hd) — post-rotary keys/values, stored at
+        ``num_kv_heads`` (GQA caches the grouped heads; the decode attention
+        re-broadcasts them per query group). The leading layer axis matches
+        the stacked-parameter convention, so the cache rides the same
+        ``lax.scan`` as the layer stack.
+    length: () int32 — number of valid positions, i.e. the next write slot.
+        Dynamic under jit: one executable serves every fill level of a given
+        (batch, capacity) shape.
+    """
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    length: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: ModelConfig, batch: int, capacity: int,
+               dtype=jnp.float32) -> KVCache:
+    """An empty cache for ``batch`` sequences of up to ``capacity`` tokens."""
+    shape = (cfg.num_layers, batch, capacity, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                   jnp.zeros((), jnp.int32))
+
+
+def prefill(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray,
+            capacity: int, *,
+            boundary_fn: Optional[Callable] = None,
+            compute_dtype: Optional[jnp.dtype] = None):
+    """Full forward over the prompt that also fills the decode cache.
+
+    Returns (logits (B, S, V) fp32, KVCache with length = S). ``capacity`` is
+    static — it fixes the cache buffers' shape, so every later ``decode_step``
+    reuses one executable regardless of how full the cache is.
+    """
+    s = input_ids.shape[1]
+    if not 0 < s <= capacity:
+        raise ValueError(f"prompt length {s} must be in [1, capacity={capacity}]")
+    params = _cast_params(params, compute_dtype)
+    hidden = embed(params, input_ids)
+    hidden, aux = run_layers(cfg, params, hidden, boundary_fn=boundary_fn,
+                             collect_kv=True)
+    logits = unembed(cfg, params, hidden)
+    k, v = aux["kv"]  # (L, B, S, KV, hd) each
+    pad = ((0, 0), (0, 0), (0, capacity - s), (0, 0), (0, 0))
+    return logits, KVCache(jnp.pad(k, pad), jnp.pad(v, pad),
+                           jnp.asarray(s, jnp.int32))
+
+
+def _attention_decode(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+                      cos_t, sin_t, k_cache, v_cache, pos,
+                      tp_axis: Optional[str] = None):
+    """One layer's attention for a single decode position: project the (B, 1, D)
+    hidden, rotate at ``pos``, write the new K/V into the cache, then attend
+    q_len=1 against the length-masked cache. Returns (out, k_cache, v_cache)."""
+    b, s1, d = x.shape
+    hd = cfg.head_dim
+    h, kv = lp["wq"].shape[-1] // hd, lp["wk"].shape[-1] // hd
+    q = (x @ lp["wq"]).reshape(b, s1, h, hd)
+    k = (x @ lp["wk"]).reshape(b, s1, kv, hd)
+    v = (x @ lp["wv"]).reshape(b, s1, kv, hd)
+    if "bq" in lp:
+        q = q + lp["bq"].reshape(h, hd)
+        k = k + lp["bk"].reshape(kv, hd)
+        v = v + lp["bv"].reshape(kv, hd)
+    q = apply_rotary(q, cos_t, sin_t, cfg.rotary_dim)
+    k = apply_rotary(k, cos_t, sin_t, cfg.rotary_dim)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+
+    from .flash_attention import decode_attention
+
+    out = decode_attention(q, k_cache, v_cache, pos + 1)
+    out = out.reshape(b, s1, h * hd) @ lp["wo"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    if "bo" in lp:
+        out = out + lp["bo"]
+    return out, k_cache, v_cache
+
+
+def block_decode(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray,
+                 cos_t, sin_t, k_cache, v_cache, pos,
+                 tp_axis: Optional[str] = None):
+    """The cache-carrying twin of :func:`block` for one decode position.
+    ``k_cache``/``v_cache`` are this layer's (B, capacity, KV, hd) buffers;
+    ``pos`` is the (traced) position being written. Returns
+    (hidden, k_cache, v_cache)."""
+    if cfg.family == "gpt_neox":
+        attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
+        attn_out, k_cache, v_cache = _attention_decode(
+            cfg, lp, attn_in, cos_t, sin_t, k_cache, v_cache, pos, tp_axis)
+        mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
+        return (hidden + attn_out + mlp(cfg, lp, mlp_in, tp_axis),
+                k_cache, v_cache)
+    attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
+    attn_out, k_cache, v_cache = _attention_decode(
+        cfg, lp, attn_in, cos_t, sin_t, k_cache, v_cache, pos, tp_axis)
+    hidden = hidden + attn_out
+    mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
+    return hidden + mlp(cfg, lp, mlp_in, tp_axis), k_cache, v_cache
+
+
+def decode_step(cfg: ModelConfig, params: dict, cache: KVCache,
+                token_ids: jnp.ndarray, *,
+                boundary_fn: Optional[Callable] = None,
+                compute_dtype: Optional[jnp.dtype] = None):
+    """Append one position: (B,) or (B, 1) token ids -> (logits (B, V) fp32,
+    updated cache). The RoPE tables are built for the full capacity and the
+    current row is dynamically sliced at ``cache.length``, so the same
+    machinery (partial rotary, llama3 scaling) applies at a position offset
+    without retracing; jit this per (batch, capacity) shape and every emitted
+    token reuses the one executable.
+    """
+    params = _cast_params(params, compute_dtype)
+    if token_ids.ndim == 1:
+        token_ids = token_ids[:, None]
+    hidden = embed(params, token_ids)  # (B, 1, D)
+    pos = cache.length
+    cos, sin = precompute_rope(cfg, cache.capacity)
+    cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, 1)
+    sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, 1)
+    idxs = jnp.arange(cfg.num_layers)
+
+    def body(h, xs):
+        lp, kc, vc, idx = xs
+        h, kc, vc = block_decode(cfg, lp, h, cos_t, sin_t, kc, vc, pos)
+        if boundary_fn is not None:
+            h = boundary_fn(idx, h)
+        return h, (kc, vc)
+
+    hidden, (k_new, v_new) = jax.lax.scan(
+        body, hidden, (params["layers"], cache.k, cache.v, idxs))
+    logits = unembed(cfg, params, hidden)[:, -1]  # (B, V) fp32
+    return logits, KVCache(k_new, v_new, pos + 1)
 
 
 def nll_from_logits(logits: jnp.ndarray, target_ids: jnp.ndarray,

@@ -26,8 +26,9 @@ from typing import Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..utils.jax_compat import axis_size, shard_map
 
 from ..models.configs import ModelConfig
 from ..models.transformer import (
@@ -74,7 +75,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     requested). Returns ``(out, (col_sum/S, last_row))`` with stats on,
     plain ``out`` otherwise (a bare array composes with shard_map out_specs).
     """
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, hd = q.shape
     rep = h // k.shape[2]

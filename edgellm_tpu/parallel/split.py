@@ -31,12 +31,13 @@ from typing import Optional, Sequence
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import ModelConfig
-from ..models.transformer import block, embed, unembed, precompute_rope
+from ..models.transformer import (block, block_decode, embed, unembed,
+                                  precompute_rope, KVCache)
 from ..codecs.packing import get_wire_codec, WireCodec
+from ..utils.jax_compat import shard_map, pcast_varying
 
 
 def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
@@ -118,6 +119,32 @@ def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
             hidden = jnp.where(idx == s + 1, codecs[s].decode(moved), hidden)
     return jax.lax.psum(
         jnp.where(idx == n_stages - 1, hidden, jnp.zeros_like(hidden)), axis_name)
+
+
+def run_pipeline_stages_carry(n_stages: int, codecs: list, run_stage, hidden,
+                              carry, axis_name: str = "stage"):
+    """:func:`run_pipeline_stages` for stage bodies that thread stage-local
+    state (the decode KV cache): ``run_stage(hidden, carry) -> (hidden,
+    carry)``. Each device keeps the carry produced at ITS unroll step — the
+    step where the hidden it transformed was the real pipeline activation —
+    so per-stage caches update exactly once per token, and nothing but the
+    (B, 1, D) boundary activation ever crosses a cut. Returns
+    (final hidden, carry)."""
+    idx = jax.lax.axis_index(axis_name)
+    for s in range(n_stages):
+        computed, new_carry = run_stage(hidden, carry)
+        keep = idx == s
+        hidden = jnp.where(keep, computed, hidden)
+        carry = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(keep, new, old), new_carry, carry)
+        if s < n_stages - 1:
+            payload = codecs[s].encode(hidden)
+            moved = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, axis_name, [(s, s + 1)]), payload)
+            hidden = jnp.where(idx == s + 1, codecs[s].decode(moved), hidden)
+    out = jax.lax.psum(
+        jnp.where(idx == n_stages - 1, hidden, jnp.zeros_like(hidden)), axis_name)
+    return out, carry
 
 
 def hop_payload_bytes(codecs, cfg, batch: int, seq: int) -> list:
@@ -252,6 +279,7 @@ class SplitRuntime:
                     f"diverge from a single-device run under data parallelism "
                     f"(n_data={mesh.shape['data']}); use per-token codecs or n_data=1")
         self._forward = self._build_forward()
+        self._decode_fns_cache: dict = {}  # capacity -> (prefill_fn, step_fn)
 
     # ---------- parameter placement ----------
 
@@ -309,7 +337,7 @@ class SplitRuntime:
             valid = local_valid[0]  # (sz,)
             # the carry becomes stage-varying after the first scan step; promote
             # the replicated input so the vma types line up
-            hidden = jax.lax.pcast(hidden, ("stage",), to="varying")
+            hidden = pcast_varying(hidden, ("stage",))
 
             def scan_body(h, xs):
                 lp, ok = xs
@@ -388,6 +416,150 @@ class SplitRuntime:
                                                     blank.shape)
                               for i in imps]))
         return self._forward(placed_params, input_ids, stacked)
+
+    # ---------- incremental decode ----------
+    #
+    # The regime where the paper's boundary-quantization question bites
+    # hardest: at decode time each cut moves ONE token's hidden state per
+    # step, so codec overhead dominates the hop. The per-stage KV caches
+    # never cross a cut — each stage keeps its own layers' cache sharded on
+    # "stage"; only the (B, 1, D) activation is encoded/ppermuted/decoded.
+
+    def _check_decode_supported(self):
+        if self.mesh.shape["data"] > 1 or self.mesh.shape["model"] > 1:
+            raise ValueError(
+                "split decode supports stage-only meshes (n_data=n_model=1); "
+                f"got data={self.mesh.shape['data']}, model={self.mesh.shape['model']}")
+        bad = [c.name for c in self.codecs if c.needs_importance]
+        if bad:
+            raise ValueError(
+                f"token-selective hop codecs {bad} have no importance source "
+                f"for a single decode position; use per-token/channel codecs")
+
+    def _decode_fns(self, capacity: int):
+        """Build (or fetch) the jitted prefill/step executables for one cache
+        capacity. Capacity is static (it fixes the cache buffers); the fill
+        level rides as a traced scalar, so each capacity compiles exactly one
+        step executable no matter how many tokens are emitted."""
+        if capacity in self._decode_fns_cache:
+            return self._decode_fns_cache[capacity]
+        cfg, n_stages, sz = self.cfg, self.split.n_stages, self.stage_size
+        codecs, mesh = self.codecs, self.mesh
+        layer_pspec = self._layer_pspec
+
+        def stage_prefill(local_layers, local_valid, hidden, cos, sin):
+            lv = {k: v[0] for k, v in local_layers.items()}  # (sz, ...)
+            valid = local_valid[0]
+            s = hidden.shape[1]
+            hidden = pcast_varying(hidden, ("stage",))
+            zeros = jnp.zeros((sz,) + hidden.shape[:1] + (capacity,)
+                              + (cfg.num_kv_heads, cfg.head_dim), hidden.dtype)
+
+            def scan_body(h, xs):
+                lp, ok = xs
+                out, _, (kl, vl) = block(cfg, lp, h, cos, sin,
+                                         capture_stats=False, return_kv=True)
+                return jnp.where(ok, out, h), (kl, vl)
+
+            def run_stage(h, cache):
+                computed, (ks, vs) = jax.lax.scan(scan_body, h, (lv, valid))
+                kc, vc = cache  # (sz, B, capacity, KV, hd)
+                return computed, (kc.at[:, :, :s].set(ks),
+                                  vc.at[:, :, :s].set(vs))
+
+            out, (kc, vc) = run_pipeline_stages_carry(
+                n_stages, codecs, run_stage, hidden, (zeros, zeros))
+            return out, kc[None], vc[None]
+
+        def stage_step(local_layers, local_valid, hidden, k_loc, v_loc,
+                       cos_t, sin_t, pos):
+            lv = {k: v[0] for k, v in local_layers.items()}
+            valid = local_valid[0]
+            hidden = pcast_varying(hidden, ("stage",))
+
+            def scan_body(h, xs):
+                lp, ok, kl, vl = xs
+                out, kl2, vl2 = block_decode(cfg, lp, h, cos_t, sin_t,
+                                             kl, vl, pos)
+                # padding layers are identity AND must not touch their cache
+                return jnp.where(ok, out, h), (jnp.where(ok, kl2, kl),
+                                               jnp.where(ok, vl2, vl))
+
+            def run_stage(h, cache):
+                kc, vc = cache
+                h2, (kc2, vc2) = jax.lax.scan(scan_body, h,
+                                              (lv, valid, kc, vc))
+                return h2, (kc2, vc2)
+
+            out, (kc, vc) = run_pipeline_stages_carry(
+                n_stages, codecs, run_stage, hidden, (k_loc[0], v_loc[0]))
+            return out, kc[None], vc[None]
+
+        @jax.jit
+        def prefill_fn(placed, input_ids):
+            hidden = embed(placed, input_ids)
+            cos, sin = precompute_rope(cfg, input_ids.shape[1])
+            lspecs = {k: layer_pspec(k, v.ndim)
+                      for k, v in placed["layers"].items()}
+            out, kc, vc = shard_map(
+                stage_prefill, mesh=mesh,
+                in_specs=(lspecs, P("stage"), P(), P(), P()),
+                out_specs=(P(), P("stage"), P("stage")),
+                check_vma=False,
+            )(placed["layers"], placed["layers_valid"], hidden, cos, sin)
+            return unembed(cfg, placed, out), kc, vc
+
+        @jax.jit
+        def step_fn(placed, k_cache, v_cache, length, token_ids):
+            hidden = embed(placed, token_ids[:, None])  # (B, 1, D)
+            cos, sin = precompute_rope(cfg, capacity)
+            cos_t = jax.lax.dynamic_slice_in_dim(cos, length, 1)
+            sin_t = jax.lax.dynamic_slice_in_dim(sin, length, 1)
+            lspecs = {k: layer_pspec(k, v.ndim)
+                      for k, v in placed["layers"].items()}
+            out, kc, vc = shard_map(
+                stage_step, mesh=mesh,
+                in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                          P(), P(), P()),
+                out_specs=(P(), P("stage"), P("stage")),
+                check_vma=False,
+            )(placed["layers"], placed["layers_valid"], hidden,
+              k_cache, v_cache, cos_t, sin_t, length)
+            return unembed(cfg, placed, out)[:, -1], kc, vc
+
+        self._decode_fns_cache[capacity] = (prefill_fn, step_fn)
+        return self._decode_fns_cache[capacity]
+
+    def prefill_decode(self, placed_params: dict, input_ids: jnp.ndarray,
+                       capacity: int):
+        """Pipeline-split prefill that also fills the per-stage KV caches.
+        Returns (logits (B, S, V) fp32, cache dict) — feed the cache to
+        :meth:`decode_step`. Cache k/v: (n_stages, sz, B, capacity, KV, hd),
+        sharded P("stage") like the layer groups they mirror."""
+        self._check_decode_supported()
+        s = input_ids.shape[1]
+        if not 0 < s <= capacity:
+            raise ValueError(
+                f"prompt length {s} must be in [1, capacity={capacity}]")
+        prefill_fn, _ = self._decode_fns(int(capacity))
+        logits, kc, vc = prefill_fn(placed_params, input_ids)
+        return logits, {"k": kc, "v": vc, "length": jnp.asarray(s, jnp.int32)}
+
+    def decode_step(self, placed_params: dict, cache: dict,
+                    token_ids: jnp.ndarray):
+        """One decode position across the pipeline: each cut quantizes the
+        single-token hidden state through its wire codec. Returns
+        (logits (B, V) fp32, updated cache)."""
+        capacity = cache["k"].shape[3]
+        _, step_fn = self._decode_fns(int(capacity))
+        logits, kc, vc = step_fn(placed_params, cache["k"], cache["v"],
+                                 cache["length"], token_ids)
+        return logits, {"k": kc, "v": vc, "length": cache["length"] + 1}
+
+    def decode_hop_bytes(self, batch: int) -> list:
+        """Measured payload bytes per hop for ONE decode step's (batch, 1, D)
+        boundary activation — bytes/token is this divided by ``batch``."""
+        return hop_payload_bytes(self.codecs, self.cfg, batch, 1)
 
     # ---------- accounting ----------
 

@@ -1,0 +1,3 @@
+from .decode import generate, decode_step_cache_size
+
+__all__ = ["generate", "decode_step_cache_size"]

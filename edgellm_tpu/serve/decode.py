@@ -1,0 +1,123 @@
+"""Batched autoregressive generation over the KV-cached decode runtime.
+
+The serving loop the ROADMAP's north star needs and the evaluation entry
+points cannot provide: ``forward`` reprocesses the whole window per emitted
+token (N tokens = N full prefills), while this loop runs ONE prefill and then
+O(1) ``decode_step`` calls against the cache.
+
+Compilation contract: the per-step executable is compiled once per
+(batch, capacity) shape. Capacity is static (it fixes the cache buffers);
+``cache.length`` is a traced scalar, so every fill level of the cache — and
+every emitted token — reuses the same executable. ``generate`` exposes the
+jit cache-miss delta in its ``stats`` dict precisely so tests can assert the
+no-retrace property instead of trusting it.
+
+Sampling: ``temperature == 0`` is greedy argmax; ``temperature > 0`` draws
+from ``categorical(logits / temperature)`` with a per-step ``fold_in`` of the
+caller's key, so a fixed key is reproducible and steps are decorrelated. The
+temperature is a static jit arg — the greedy executable contains no RNG at
+all.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.configs import ModelConfig
+from ..models.transformer import decode_step, prefill
+
+
+def _sample(logits, key, temperature: float):
+    """(B, V) fp32 logits -> (B,) int32 token ids."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
+
+
+def _prefill_impl(cfg, params, prompt_ids, capacity, compute_dtype):
+    logits, cache = prefill(cfg, params, prompt_ids, capacity,
+                            compute_dtype=compute_dtype)
+    return logits[:, -1], cache  # only the last position seeds generation
+
+
+def _step_impl(cfg, params, cache, token_ids, key, temperature, compute_dtype):
+    logits, cache = decode_step(cfg, params, cache, token_ids,
+                                compute_dtype=compute_dtype)
+    return _sample(logits, key, temperature), cache
+
+
+_prefill_jit = jax.jit(_prefill_impl,
+                       static_argnames=("cfg", "capacity", "compute_dtype"))
+_step_jit = jax.jit(_step_impl,
+                    static_argnames=("cfg", "temperature", "compute_dtype"))
+
+
+def decode_step_cache_size() -> int:
+    """Number of per-step executables compiled so far in this process — the
+    jit-cache-miss counter ``generate`` reports deltas of."""
+    return _step_jit._cache_size()
+
+
+def generate(cfg: ModelConfig, params: dict, prompt_ids, max_new_tokens: int,
+             *,
+             capacity: Optional[int] = None,
+             temperature: float = 0.0,
+             rng_key: Optional[jax.Array] = None,
+             compute_dtype=None,
+             stats: Optional[dict] = None) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` per batch row after a KV-cached prefill.
+
+    prompt_ids: (B, S) int token ids. Returns (B, max_new_tokens) int32.
+    ``capacity`` (static; default exactly prompt+new) bounds the cache —
+    prompts that would overflow it raise instead of silently wrapping.
+    ``stats``, when given, is filled with timing and the per-step jit
+    cache-miss delta (0 on a warm shape, 1 on a cold one).
+    """
+    prompt_ids = jnp.asarray(prompt_ids)
+    if prompt_ids.ndim != 2:
+        raise ValueError(f"prompt_ids must be (B, S), got {prompt_ids.shape}")
+    b, s = prompt_ids.shape
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    capacity = s + max_new_tokens if capacity is None else int(capacity)
+    if s + max_new_tokens > capacity:
+        raise ValueError(
+            f"cache capacity overflow: prompt {s} + {max_new_tokens} new "
+            f"tokens > capacity {capacity}")
+    temperature = float(temperature)
+    if temperature < 0.0:
+        raise ValueError("temperature must be >= 0")
+    key = jax.random.key(0) if rng_key is None else rng_key
+    misses0 = decode_step_cache_size()
+
+    t0 = time.monotonic()
+    last_logits, cache = _prefill_jit(cfg, params, prompt_ids, capacity,
+                                      compute_dtype)
+    tok = _sample(last_logits, jax.random.fold_in(key, 0), temperature)
+    jax.block_until_ready(tok)
+    t1 = time.monotonic()
+
+    toks = [tok]
+    for t in range(1, max_new_tokens):
+        tok, cache = _step_jit(cfg, params, cache, tok,
+                               jax.random.fold_in(key, t), temperature,
+                               compute_dtype)
+        toks.append(tok)
+    out = jnp.stack(toks, axis=1)  # (B, max_new_tokens)
+    jax.block_until_ready(out)
+    t2 = time.monotonic()
+
+    if stats is not None:
+        steps = max_new_tokens - 1  # tokens emitted by the decode loop proper
+        stats.update(
+            capacity=capacity,
+            prefill_s=t1 - t0,
+            decode_s=t2 - t1,
+            decode_steps=steps,
+            decode_tokens_per_s=(b * steps / (t2 - t1)) if steps else 0.0,
+            decode_step_cache_misses=decode_step_cache_size() - misses0,
+        )
+    return out

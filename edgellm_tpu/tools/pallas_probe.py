@@ -294,6 +294,10 @@ def probe_codec(name: str, *, batch: int = 8, seq: int = 512, dim: int = 896,
         result["roundtrip_us"] = round(t_rt_p * 1e6, 1)
     if math.isfinite(rt_ratio):
         result["roundtrip_speedup_vs_jnp"] = round(rt_ratio, 2)
+        # the UNROUNDED ratio is what the probe cache persists: the
+        # WIN_MARGIN=1.05 hysteresis must never compare against a display
+        # value a 1.045 reading was rounded up into (ADVICE r5 #3)
+        result["roundtrip_speedup_vs_jnp_raw"] = rt_ratio
     if not timing_detail:
         return result
 

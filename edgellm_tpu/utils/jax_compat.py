@@ -1,0 +1,48 @@
+"""Version shims for the jax APIs the parallel layer leans on.
+
+The runtimes are written against the current jax surface (top-level
+``jax.shard_map`` with ``check_vma`` and varying-mode ``lax.pcast``), but the
+deployment images pin older releases where ``shard_map`` still lives in
+``jax.experimental.shard_map`` with the ``check_rep`` spelling and no vma
+typing at all. Every shard_map user in the package (and the tests that build
+their own shard_maps) imports from here so the whole repo tracks exactly one
+compatibility decision.
+"""
+from __future__ import annotations
+
+import jax
+
+try:  # jax >= 0.6: top-level export, vma typing, check_vma kwarg
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication/vma check flag translated to
+    whatever the installed jax calls it (``check_vma`` vs ``check_rep``)."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **{_CHECK_KW: check_vma})
+
+
+def axis_size(axis_name) -> int:
+    """``lax.axis_size`` where it exists; on older jax the size of a mapped
+    axis is recoverable as ``psum(1)`` over it (constant-folded, not a
+    collective — the literal is replicated so the sum is the axis size)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def pcast_varying(x, axes):
+    """``lax.pcast(x, axes, to="varying")`` where vma typing exists; identity
+    on jax versions whose shard_map has no vma types to promote (the cast is
+    purely a type-system operation — no data movement either way)."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is None:
+        return x
+    return pcast(x, axes, to="varying")

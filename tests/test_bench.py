@@ -36,3 +36,42 @@ def test_bench_main_headline_is_final_compact_line(monkeypatch, capsys, tmp_path
     detail = json.loads(out[-2])["detail"]
     assert detail["requested_window_batch"] == 2
     assert json.load(open(tmp_path / "detail.json")) == detail
+
+
+def test_bench_decode_headline(monkeypatch, capsys, tmp_path):
+    """BENCH_DECODE=1 flips the bench to the KV-cached decode workload with
+    the same stdout contract: compact headline as the FINAL line, verbose
+    decode block (incl. split hop bytes/token) on the detail line/sidecar."""
+    sys.modules.pop("bench", None)
+    import bench
+
+    monkeypatch.setenv("BENCH_DETAIL_PATH", str(tmp_path / "detail.json"))
+    monkeypatch.setenv("BENCH_DECODE", "1")
+    monkeypatch.setenv("BENCH_MODEL", "tiny-qwen2")
+    monkeypatch.setenv("BENCH_DECODE_PROMPT", "8")
+    monkeypatch.setenv("BENCH_DECODE_TOKENS", "8")
+    monkeypatch.setenv("BENCH_DECODE_BATCH", "2")
+    monkeypatch.setenv("BENCH_DECODE_SPLIT", "1")
+    monkeypatch.setenv("BENCH_DTYPE", "float32")
+    monkeypatch.setenv("BENCH_REPEATS", "1")
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    line = json.loads(out[-1])
+    assert line["unit"] == "decode tokens/s" and line["value"] > 0
+    assert line["vs_baseline"] is None
+    assert line["batch"] == 2
+    assert "decode" in line["metric"]
+    assert line["decode_step_cache_misses"] == 1  # compiled once, ever
+    assert len(out[-1]) < 1024
+    assert set(line) <= {"metric", "value", "unit", "vs_baseline",
+                         "tokens_per_s", "prefill_s", "batch",
+                         "decode_step_cache_misses"}
+    detail = json.loads(out[-2])["detail"]
+    dec = detail["decode"]
+    assert dec["prompt"] == 8 and dec["batch"] == 2
+    assert dec["split_hop_bytes_per_token"] > 0
+    # conftest spoofs 8 CPU devices, so the split section must have run
+    assert dec["split"]["tokens_per_s"] > 0
+    assert dec["split"]["hop_bytes_per_token"] == [
+        b / 2 for b in dec["split"]["measured_hop_bytes_per_step"]]
+    assert json.load(open(tmp_path / "detail.json")) == detail

@@ -1,0 +1,293 @@
+"""KV-cached incremental decode: parity, capacity, compile-once, split hops.
+
+The decode subsystem's correctness anchor is teacher-forced parity: feeding the
+same token sequence through prefill + repeated ``decode_step`` must reproduce
+the full-sequence ``forward`` logits at every position, for both attention
+layouts — GPT-NeoX (parallel residual, partial rotary, MHA) and Qwen2 (GQA,
+where the cache stores ``num_kv_heads`` and decode attention re-broadcasts per
+query group). The ISSUE acceptance pins this at preset scale (pythia-70m and
+qwen2-0.5b, atol 1e-4 fp32) on top of the fast tiny-config checks.
+
+Also covered here: the serve loop's greedy output vs an iterated full-forward
+oracle, cache-capacity overflow behavior, the compiled-once-per-(batch,
+capacity) contract via the jit cache-miss counter, and the split-decode mode
+whose per-step boundary hop quantizes a single token's hidden state through a
+real wire codec over ppermute (checked against the in-place ``simulate`` codec
+at the cut, the same pairing ``test_split.py`` uses for full-sequence hops).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.models import (
+    PRESETS, tiny_config, init_params, forward, nll_from_logits,
+    KVCache, init_cache, prefill, decode_step,
+)
+from edgellm_tpu.models.flash_attention import decode_attention
+from edgellm_tpu.codecs import per_token_affine_int8
+from edgellm_tpu.codecs.packing import selective_int4
+from edgellm_tpu.parallel import SplitConfig, SplitRuntime, make_stage_mesh
+from edgellm_tpu.serve import generate
+
+TINY = {
+    "gpt_neox": tiny_config("gpt_neox", num_layers=3, hidden_size=32,
+                            num_heads=4, vocab_size=128),
+    "qwen2": tiny_config("qwen2", num_layers=3, hidden_size=32, num_heads=4,
+                         vocab_size=128),
+}
+
+
+def _ids(cfg, batch, seq, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, seq)))
+
+
+def _teacher_forced_decode(cfg, params, ids, prompt_len, capacity):
+    """prefill on ids[:, :prompt_len], then decode_step over the rest; returns
+    (prefill logits, [per-step logits]) with the final cache."""
+    step = jax.jit(decode_step, static_argnames=("cfg",))
+    pre_logits, cache = prefill(cfg, params, ids[:, :prompt_len], capacity)
+    steps = []
+    for t in range(prompt_len, ids.shape[1]):
+        logits, cache = step(cfg, params, cache, ids[:, t])
+        steps.append(logits)
+    return pre_logits, steps, cache
+
+
+@pytest.mark.parametrize("family", ["gpt_neox", "qwen2"])
+def test_tiny_decode_matches_forward(family):
+    cfg = TINY[family]
+    params = init_params(cfg, jax.random.key(2))
+    ids = _ids(cfg, 2, 16, seed=3)
+    full, _ = forward(cfg, params, ids)
+
+    pre_logits, steps, cache = _teacher_forced_decode(cfg, params, ids, 7, 16)
+    np.testing.assert_allclose(np.asarray(pre_logits), np.asarray(full[:, :7]),
+                               atol=1e-4, rtol=1e-4)
+    for i, logits in enumerate(steps):
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, 7 + i]),
+                                   atol=1e-4, rtol=1e-4)
+    assert int(cache.length) == 16
+
+
+def test_gqa_cache_stores_kv_heads():
+    """GQA caches the grouped heads, not the broadcast query heads."""
+    cfg = TINY["qwen2"]
+    assert cfg.num_kv_heads < cfg.num_heads
+    cache = init_cache(cfg, batch=2, capacity=8)
+    assert cache.k.shape == (cfg.num_layers, 2, 8, cfg.num_kv_heads,
+                             cfg.head_dim)
+    params = init_params(cfg, jax.random.key(0))
+    _, filled = prefill(cfg, params, _ids(cfg, 2, 5), capacity=8)
+    assert filled.k.shape == cache.k.shape
+    assert int(filled.length) == 5
+    # unfilled tail stays zero (prefill pads, decode writes one slot at a time)
+    assert np.all(np.asarray(filled.k[:, :, 5:]) == 0.0)
+
+
+def test_decode_attention_matches_dense_oracle():
+    """q_len=1 GQA attention against a length-masked cache == explicit
+    softmax over the valid prefix with keys repeated per query group."""
+    rng = np.random.default_rng(7)
+    b, cap, h, kv, hd, length = 2, 10, 4, 2, 8, 6
+    q = jnp.asarray(rng.standard_normal((b, 1, h, hd)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, cap, kv, hd)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, cap, kv, hd)), jnp.float32)
+    out = decode_attention(q, k, v, jnp.asarray(length, jnp.int32))
+
+    kr = np.repeat(np.asarray(k)[:, :length], h // kv, axis=2)  # (b, len, h, hd)
+    vr = np.repeat(np.asarray(v)[:, :length], h // kv, axis=2)
+    scores = np.einsum("bqhd,bchd->bhc", np.asarray(q), kr) / np.sqrt(hd)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = np.einsum("bhc,bchd->bhd", probs, vr)[:, None]
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)
+
+
+@pytest.mark.parametrize("preset", ["pythia-70m", "qwen2-0.5b"])
+def test_preset_decode_matches_forward(preset):
+    """ISSUE acceptance: decode_step logits == full forward logits at the same
+    positions, atol 1e-4 fp32, at real preset scale (partial rotary for
+    pythia-70m, 14q/2kv GQA for qwen2-0.5b). Shapes kept tiny (B=1, S=12) —
+    the presets' width/depth is the point, not the window."""
+    cfg = PRESETS[preset]
+    params = init_params(cfg, jax.random.key(0))
+    ids = _ids(cfg, 1, 12, seed=1)
+    full, _ = forward(cfg, params, ids)
+
+    _, steps, _ = _teacher_forced_decode(cfg, params, ids, 6, 12)
+    for i, logits in enumerate(steps):
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 6 + i]),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_generate_greedy_matches_full_forward_oracle():
+    """generate(temperature=0) == re-running the full forward after each
+    emitted token and taking argmax — the O(S)-per-token loop the cache
+    replaces."""
+    cfg = TINY["qwen2"]
+    params = init_params(cfg, jax.random.key(4))
+    prompt = _ids(cfg, 2, 6, seed=9)
+    n_new = 5
+    out = generate(cfg, params, prompt, n_new)
+    assert out.shape == (2, n_new) and out.dtype == jnp.int32
+
+    seq = np.asarray(prompt)
+    for t in range(n_new):
+        logits, _ = forward(cfg, params, jnp.asarray(seq))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        np.testing.assert_array_equal(np.asarray(out[:, t]), nxt)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+
+
+def test_generate_temperature_sampling():
+    cfg = TINY["gpt_neox"]
+    params = init_params(cfg, jax.random.key(5))
+    prompt = _ids(cfg, 3, 4, seed=11)
+    out = generate(cfg, params, prompt, 6, temperature=0.8,
+                   rng_key=jax.random.key(42))
+    assert out.shape == (3, 6) and out.dtype == jnp.int32
+    arr = np.asarray(out)
+    assert np.all((arr >= 0) & (arr < cfg.vocab_size))
+    # fixed key -> reproducible draws
+    out2 = generate(cfg, params, prompt, 6, temperature=0.8,
+                    rng_key=jax.random.key(42))
+    np.testing.assert_array_equal(arr, np.asarray(out2))
+
+
+def test_capacity_overflow_raises():
+    cfg = TINY["gpt_neox"]
+    params = init_params(cfg, jax.random.key(6))
+    prompt = _ids(cfg, 1, 8, seed=13)
+    with pytest.raises(ValueError, match="capacity overflow"):
+        generate(cfg, params, prompt, 4, capacity=10)
+    with pytest.raises(ValueError, match="capacity"):
+        prefill(cfg, params, prompt, capacity=4)
+    with pytest.raises(ValueError):
+        generate(cfg, params, prompt, 0)
+    with pytest.raises(ValueError):
+        generate(cfg, params, prompt, 2, temperature=-0.1)
+
+
+def test_decode_step_compiles_once_per_shape():
+    """ISSUE acceptance: one per-step executable per (batch, capacity) —
+    emitting more tokens or rerunning the same shape must not retrace."""
+    cfg = TINY["qwen2"]
+    params = init_params(cfg, jax.random.key(8))
+    prompt = _ids(cfg, 5, 3, seed=17)  # batch 5: unique shape for this test
+    stats = {}
+    generate(cfg, params, prompt, 8, stats=stats)
+    assert stats["decode_step_cache_misses"] == 1
+    assert stats["decode_steps"] == 7
+    stats2 = {}
+    generate(cfg, params, prompt, 8, stats=stats2)  # warm: same (batch, capacity)
+    assert stats2["decode_step_cache_misses"] == 0
+    # more tokens at the same capacity still reuse the one executable
+    stats3 = {}
+    generate(cfg, params, prompt[:, :2], 9, stats=stats3)
+    assert stats3["decode_step_cache_misses"] == 0
+
+
+# ---------------------------------------------------------------------------
+# split decode on the spoofed CPU mesh
+# ---------------------------------------------------------------------------
+
+SPLIT_CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4,
+                        vocab_size=128)
+
+
+@pytest.fixture(scope="module")
+def split_setup():
+    params = init_params(SPLIT_CFG, jax.random.key(1))
+    ids = _ids(SPLIT_CFG, 2, 14, seed=21)
+    return params, ids
+
+
+def _run_split_decode(rt, params, ids, prompt_len, capacity):
+    placed = rt.place_params(params)
+    pre_logits, cache = rt.prefill_decode(placed, ids[:, :prompt_len], capacity)
+    steps = []
+    for t in range(prompt_len, ids.shape[1]):
+        logits, cache = rt.decode_step(placed, cache, ids[:, t])
+        steps.append(logits)
+    return pre_logits, steps
+
+
+def test_split_decode_quantized_hop_preserves_nll(split_setup):
+    """Per-token decode hops through a real int8 wire codec over ppermute ==
+    the single-device decode with the matching simulate codec applied at the
+    cut — so the split changes neither the logits nor the sequence NLL."""
+    params, ids = split_setup
+    cut, prompt_len, capacity = 2, 7, 14
+    rt = SplitRuntime(SPLIT_CFG,
+                      SplitConfig(cuts=(cut,), hop_codecs=("int8_per_token",)),
+                      make_stage_mesh(2))
+    split_pre, split_steps = _run_split_decode(rt, params, ids, prompt_len,
+                                               capacity)
+
+    def bfn(idx, h):
+        return jnp.where(idx == cut, per_token_affine_int8(h), h)
+
+    step = jax.jit(decode_step, static_argnames=("cfg", "boundary_fn"))
+    ref_pre, cache = prefill(SPLIT_CFG, params, ids[:, :prompt_len], capacity,
+                             boundary_fn=bfn)
+    np.testing.assert_allclose(np.asarray(split_pre), np.asarray(ref_pre),
+                               atol=2e-5, rtol=2e-5)
+    ref_steps = []
+    for t in range(prompt_len, ids.shape[1]):
+        logits, cache = step(SPLIT_CFG, params, cache, ids[:, t],
+                             boundary_fn=bfn)
+        ref_steps.append(logits)
+    for got, want in zip(split_steps, ref_steps):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    # stitched teacher-forced logits -> NLL unchanged by the split transport
+    split_all = jnp.concatenate(
+        [split_pre] + [s[:, None] for s in split_steps], axis=1)
+    ref_all = jnp.concatenate(
+        [ref_pre] + [s[:, None] for s in ref_steps], axis=1)
+    nll_split = float(nll_from_logits(split_all, ids))
+    nll_ref = float(nll_from_logits(ref_all, ids))
+    assert abs(nll_split - nll_ref) < 1e-5
+
+
+def test_split_decode_fp32_hop_matches_unsplit(split_setup):
+    """fp32 wire: the split transport itself is lossless at decode time."""
+    params, ids = split_setup
+    rt = SplitRuntime(SPLIT_CFG, SplitConfig(cuts=(2,), hop_codecs=("fp32",)),
+                      make_stage_mesh(2))
+    split_pre, split_steps = _run_split_decode(rt, params, ids, 7, 14)
+    _, ref_steps, _ = _teacher_forced_decode(SPLIT_CFG, params, ids, 7, 14)
+    for got, want in zip(split_steps, ref_steps):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_split_decode_hop_bytes(split_setup):
+    """Decode hops move one token's hidden state: int8 per-token payload =
+    B * (D int8 bytes + 2 fp32 scale/zero) per step."""
+    params, _ = split_setup
+    rt = SplitRuntime(SPLIT_CFG,
+                      SplitConfig(cuts=(2,), hop_codecs=("int8_per_token",)),
+                      make_stage_mesh(2))
+    (per_step,) = rt.decode_hop_bytes(batch=2)
+    assert per_step == 2 * (SPLIT_CFG.hidden_size + 8)
+
+
+def test_split_decode_rejects_unsupported(split_setup):
+    params, ids = split_setup
+    # token-selective codecs have no importance source for a 1-token step
+    rt = SplitRuntime(SPLIT_CFG,
+                      SplitConfig(cuts=(2,), hop_codecs=(selective_int4(0.5),)),
+                      make_stage_mesh(2))
+    with pytest.raises(ValueError, match="importance"):
+        rt.prefill_decode(rt.place_params(params), ids[:, :4], 8)
+    # decode is stage-only: data/model axes unsupported
+    rt2 = SplitRuntime(SPLIT_CFG, SplitConfig(cuts=(2,), hop_codecs=("fp32",)),
+                       make_stage_mesh(2, n_data=2))
+    with pytest.raises(ValueError, match="stage-only"):
+        rt2.prefill_decode(rt2.place_params(params), ids[:, :4], 8)

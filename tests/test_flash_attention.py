@@ -90,7 +90,10 @@ def test_model_attention_same_under_either_backend(rng, monkeypatch):
 def test_kernel_eligibility(monkeypatch):
     monkeypatch.delenv("EDGELLM_ATTN", raising=False)
     # CPU default: no kernel (interpret mode would be slow, XLA is fine)
-    assert not kernel_eligible(512, 896)
+    with pytest.warns(DeprecationWarning, match="kernel_plan"):
+        assert not kernel_eligible(512, 896)
+    # explicit head counts: no layout inference, no warning
+    assert not kernel_eligible(512, 896, num_heads=14, num_kv_heads=2)
     monkeypatch.setenv("EDGELLM_ATTN", "pallas")
     assert kernel_plan(512, 14, 2, 64) == ("whole", None)   # flagship
     assert kernel_plan(512, 12, 2, 128) == ("whole", None)  # qwen2-1.5b
@@ -107,7 +110,20 @@ def test_kernel_eligibility(monkeypatch):
     assert kernel_plan(1536, 8, 8, 64) == ("blocked", (512, 8))
     assert kernel_plan(1100, 8, 8, 64) is None     # S not qb-aligned
     monkeypatch.setenv("EDGELLM_ATTN", "xla")
-    assert not kernel_eligible(512, 896)
+    with pytest.warns(DeprecationWarning, match="kernel_plan"):
+        assert not kernel_eligible(512, 896)
+
+
+def test_shape_plan_scales_whole_s_by_itemsize():
+    """ADVICE r5 #1: the whole-S VMEM envelope assumes bf16 rows; wider
+    dtypes shrink the eligible S/packed-dh and fall through to the blocked
+    plan (whose K/V budget is already itemsize-aware)."""
+    assert _shape_plan(1024, 12, 2, 128) == ("whole", None)          # bf16
+    assert _shape_plan(1024, 12, 2, 128, itemsize=4) != ("whole", None)
+    assert _shape_plan(512, 12, 2, 64, itemsize=4) == ("whole", None)
+    # packed-dh gate: fp32 halves the 1536-lane row budget too
+    assert _shape_plan(512, 14, 2, 96)[0] == "whole"                 # dh=1344
+    assert _shape_plan(512, 14, 2, 96, itemsize=4)[0] != "whole"
 
 
 @pytest.mark.parametrize("b,h,kv,s,hd,qb,hps", [

@@ -60,6 +60,19 @@ def test_measured_win_hit_miss(cache):
     assert probe_cache.measured_win("int8_per_channel") is False
 
 
+def test_record_prefers_unrounded_ratio(cache):
+    """ADVICE r5 #3: a 1.046x reading display-rounds to 1.05 — WIN_MARGIN
+    must see the raw ratio, or the rounding manufactures a win."""
+    probe_cache.record([{"codec": "int8_per_token",
+                         "roundtrip_speedup_vs_jnp": 1.05,
+                         "roundtrip_speedup_vs_jnp_raw": 1.046}])
+    assert probe_cache.load_speedups() == {"int8_per_token": 1.046}
+    assert probe_cache.measured_win("int8_per_token") is False
+    # rows without the raw field (older probe output) still load
+    probe_cache.record(_probe_rows(int4_per_token=1.33))
+    assert probe_cache.measured_win("int4_per_token") is True
+
+
 def test_no_data_falls_back_to_frozen_set(cache):
     for base in ("int4_per_token", "int8_per_token", "selective_int4"):
         assert default_substituted(base) == (base in PALLAS_DEFAULT_WINS)

@@ -15,7 +15,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from edgellm_tpu.utils.jax_compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from edgellm_tpu.models import tiny_config, init_params
