@@ -39,6 +39,14 @@ workload instead of the sweep (see ``decode_main``): headline unit becomes
 ``decode tokens/s``, with the per-step split-boundary hop bytes/token in the
 detail sidecar. The stdout contract is identical.
 
+BENCH_FAULTS=1 switches to the boundary-wire robustness workload (see
+``faults_main``): a seeded fault-rate sweep over the REAL split runtime — PPL
+and per-hop detected/retried/recovered/substituted counters per rate in the
+detail sidecar, plus clean-vs-faulty split decode tokens/s when >= 2 devices
+are visible. Knobs: BENCH_FAULT_RATES (comma floats, default "0,0.05,0.2"),
+BENCH_FAULT_KNOB (drop_rate|bitflip_rate|scale_corrupt_rate),
+BENCH_FAULT_RETRIES, BENCH_FAULT_CODEC, BENCH_FAULT_CHUNKS, BENCH_FAULT_SEED.
+
 An over-large BENCH_WINDOW_BATCH never kills the bench: on TPU an AOT
 memory-analysis preflight (tools/wb_preflight.py) halves it to the largest
 batch whose estimated peak fits BEFORE anything runs (a real TPU OOM would
@@ -180,9 +188,111 @@ def decode_main():
     _emit(line, detail)
 
 
+def faults_main():
+    """BENCH_FAULTS=1: split-boundary robustness under seeded wire faults.
+
+    One :func:`run_fault_sweep` over the real split runtime (rate 0 first —
+    the exact fault-free baseline point), then, when >= 2 devices are visible,
+    a clean-vs-faulty KV-cached split decode throughput comparison via
+    ``serve.generate_split``. The headline value is the PPL at the worst
+    swept rate; ``ppl_clean`` / ``ppl_ratio`` and the summed per-rate fault
+    counters make the degradation (and the integrity layer's recovery work)
+    auditable from the sidecar."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.codecs.faults import FaultConfig, LinkPolicy
+    from edgellm_tpu.eval.split_eval import run_fault_sweep
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    rates = sorted(float(r) for r in os.environ.get(
+        "BENCH_FAULT_RATES", "0,0.05,0.2").split(","))
+    knob = os.environ.get("BENCH_FAULT_KNOB", "drop_rate")
+    retries = int(os.environ.get("BENCH_FAULT_RETRIES", "2"))
+    codec = os.environ.get("BENCH_FAULT_CODEC", "int8_per_token")
+    n_chunks = int(os.environ.get("BENCH_FAULT_CHUNKS", "16"))
+    seed = int(os.environ.get("BENCH_FAULT_SEED", "0"))
+    max_length = int(os.environ.get("BENCH_MAX_LENGTH", "512"))
+    stride = int(os.environ.get("BENCH_STRIDE", "256"))
+    cut = min(11, cfg.num_layers // 2)
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    corpus = rng.integers(0, cfg.vocab_size,
+                          max_length + stride * (n_chunks + 2))
+
+    policy = LinkPolicy(max_retries=retries)
+    sweep = run_fault_sweep(
+        cfg, params, corpus, rates=rates, knob=knob, seed=seed,
+        link_policy=policy, cuts=(cut,), hop_codecs=[codec],
+        max_length=max_length, stride=stride, max_chunks=n_chunks,
+        time_hops=False)
+    rows = [{
+        "rate": r["fault_rate"], "ppl": round(r["ppl"], 4),
+        "tokens_per_s": round(r["tokens_per_s"], 1),
+        "link_counters": r.get("link_counters"),
+    } for r in sweep]
+    ppl_clean, ppl_worst = sweep[0]["ppl"], sweep[-1]["ppl"]
+    worst_counters = sweep[-1].get("link_counters", {})
+
+    detail = {"faults": {
+        "knob": knob, "rates": rates, "retries": retries, "codec": codec,
+        "cut": cut, "seed": seed, "chunks": n_chunks,
+        "max_length": max_length, "stride": stride, "sweep": rows,
+    }}
+
+    # decode leg: same split, clean vs worst-rate faulty wire
+    if len(jax.devices()) >= 2 and max(rates) > 0:
+        from edgellm_tpu.parallel.split import (SplitConfig, SplitRuntime,
+                                                make_stage_mesh)
+        from edgellm_tpu.serve.decode import generate_split
+
+        split = SplitConfig(cuts=(cut,), hop_codecs=(codec,))
+        mesh = make_stage_mesh(2)
+        prompt, new_tokens, batch = 64, 64, 4
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt)))
+        decode = {}
+        for label, fc in (
+                ("clean", None),
+                ("faulty", FaultConfig(**{knob: max(rates)}, seed=seed))):
+            rt = SplitRuntime(cfg, split, mesh, faults=fc, policy=policy)
+            placed = rt.place_params(params)
+            generate_split(rt, placed, ids, new_tokens)  # compile
+            st: dict = {}
+            generate_split(rt, placed, ids, new_tokens, stats=st)
+            decode[label] = {
+                "decode_tokens_per_s": round(st["decode_tokens_per_s"], 2)}
+            if "link_counters" in st:
+                decode[label]["link_counters"] = st["link_counters"]
+        detail["faults"]["decode"] = decode
+
+    line = {
+        "metric": (f"{model_name} split PPL under {knob}={max(rates)} "
+                   f"(cut {cut}, {codec}, retries {retries})"),
+        "value": round(ppl_worst, 4),
+        "unit": "ppl",
+        "vs_baseline": None,  # the reference models a lossless boundary
+        "ppl_clean": round(ppl_clean, 4),
+        "ppl_ratio": round(ppl_worst / ppl_clean, 4),
+        "detected": sum(worst_counters.get("detected", [])),
+        "recovered": sum(worst_counters.get("recovered", [])),
+        "substituted": sum(worst_counters.get("substituted", [])),
+    }
+    dec = detail["faults"].get("decode")
+    if dec:
+        line["decode_tokens_per_s_clean"] = dec["clean"]["decode_tokens_per_s"]
+        line["decode_tokens_per_s_faulty"] = dec["faulty"]["decode_tokens_per_s"]
+    _emit(line, detail)
+
+
 def main():
     if os.environ.get("BENCH_DECODE") == "1":
         return decode_main()
+    if os.environ.get("BENCH_FAULTS") == "1":
+        return faults_main()
     import jax
     import jax.numpy as jnp
     from edgellm_tpu.models import PRESETS, init_params

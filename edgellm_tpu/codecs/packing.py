@@ -63,6 +63,35 @@ def _nbytes(tree) -> int:
                    for a in jax.tree_util.tree_leaves(tree)))
 
 
+#: saturation bound for pathological encoder inputs; well inside fp32 range so
+#: downstream scale arithmetic (division, reciprocal-multiply) stays finite
+SATURATE_MAG = 1e30
+
+
+def sanitize_hidden(h: jnp.ndarray, max_mag: float = SATURATE_MAG) -> jnp.ndarray:
+    """Deterministic saturation of pathological activations before encoding:
+    NaN -> 0, +-Inf and magnitudes beyond ``max_mag`` clamp to ``+-max_mag``.
+    A bit-exact identity for ordinary finite inputs (clip and a false-predicate
+    where both return x unchanged), so codec parity with the simulate path is
+    untouched — but no wire codec ever turns a poisoned activation into silent
+    garbage bytes: every payload decodes to something finite."""
+    h = jnp.clip(h, -max_mag, max_mag)  # NaN propagates through clip...
+    return jnp.where(jnp.isnan(h), jnp.zeros_like(h), h)  # ...and lands here
+
+
+def _saturating(codec: "WireCodec", max_mag: float = SATURATE_MAG) -> "WireCodec":
+    """Wrap a codec's encode with :func:`sanitize_hidden` (identity for finite
+    inputs). Every registry codec and every Pallas twin passes through this."""
+    enc = codec.encode
+    if codec.needs_importance:
+        def wrapped(h, importance):
+            return enc(sanitize_hidden(h, max_mag), importance)
+    else:
+        def wrapped(h):
+            return enc(sanitize_hidden(h, max_mag))
+    return dataclasses.replace(codec, encode=wrapped)
+
+
 @dataclasses.dataclass(frozen=True)
 class WireCodec:
     """One boundary codec: ``encode(hidden) -> payload`` (pytree of arrays that
@@ -95,11 +124,14 @@ class WireCodec:
 
 
 def _identity_codec(name: str, dtype) -> WireCodec:
-    return WireCodec(
+    # saturate to the WIRE dtype's own range (fp16 overflows far below
+    # SATURATE_MAG), so a huge input crosses as the dtype max, never as Inf
+    max_mag = min(SATURATE_MAG, float(jnp.finfo(dtype).max))
+    return _saturating(WireCodec(
         name=name,
         encode=lambda h: {"x": h.astype(dtype)},
         decode=lambda p: p["x"].astype(jnp.float32),
-    )
+    ), max_mag)
 
 
 def _int8_per_token() -> WireCodec:
@@ -178,6 +210,25 @@ def _ternary(kind: str) -> WireCodec:
         return unpack_ternary(p["packed"]).astype(jnp.float32) * p["scale"]
 
     return WireCodec(f"ternary_{kind}", encode, decode, batch_invariant=False)
+
+
+def _ternary_per_token() -> WireCodec:
+    """Per-token symmetric ternary: D/4 packed crumbs + one fp32 max-abs scale
+    per token. The degradation ladder's floor tier (``codecs.faults``): unlike
+    the per-channel ternary codecs its scale reduces only over the feature
+    axis, so it is batch-invariant — legal under data parallelism and the
+    stage x seq runtime, and usable for single-token decode hops."""
+
+    def encode(h):
+        mx = jnp.max(jnp.abs(h), axis=-1, keepdims=True)
+        scale = jnp.where(mx > 0, mx, 1.0)
+        codes = jnp.clip(jnp.round(h / scale), -1, 1).astype(jnp.int8)
+        return {"packed": pack_ternary(codes), "scale": scale}
+
+    def decode(p):
+        return unpack_ternary(p["packed"]).astype(jnp.float32) * p["scale"]
+
+    return WireCodec("ternary_per_token", encode, decode)
 
 
 def _int8_per_channel() -> WireCodec:
@@ -331,8 +382,11 @@ def selective_int4(ratio: float, high: str = "bf16", *,
         out = out.at[:, low_idx, :].set(low)
         return out.at[:, high_pos, :].set(p["high"].astype(jnp.float32))
 
-    return WireCodec(f"selective_int4_r{ratio}_{high}{name_suffix}", encode, decode,
-                     batch_invariant=False, needs_importance=True)
+    # high tokens cross at `high` precision: saturate to THAT dtype's range
+    return _saturating(
+        WireCodec(f"selective_int4_r{ratio}_{high}{name_suffix}", encode, decode,
+                  batch_invariant=False, needs_importance=True),
+        min(SATURATE_MAG, float(jnp.finfo(high_dtype).max)))
 
 
 def _pallas(base_name: str) -> Callable[[], WireCodec]:
@@ -352,17 +406,21 @@ def get_wire_codec(name: str) -> WireCodec:
     (fp16 is its notional uncompressed transfer baseline, BASELINE.md). The
     ``*_pallas`` names select the fused TPU kernel implementation explicitly;
     on TPU the split runtime substitutes them for the jnp twins automatically."""
+    # identity codecs, selective_int4, and the Pallas twins sanitize inside
+    # their own factories (dtype-specific bounds / shared twin path); the
+    # quantizing jnp codecs are wrapped here
     factories = {
         "fp32": lambda: _identity_codec("fp32", jnp.float32),
         "bf16": lambda: _identity_codec("bf16", jnp.bfloat16),
         "fp16": lambda: _identity_codec("fp16", jnp.float16),
-        "int8_per_token": _int8_per_token,
-        "int8_per_channel": _int8_per_channel,
-        "int4_global": _int4_global,
-        "int4_per_token": _int4_per_token,
-        "int4_per_channel": _int4_per_channel,
-        "ternary_mean": lambda: _ternary("mean"),
-        "ternary_max": lambda: _ternary("max"),
+        "int8_per_token": lambda: _saturating(_int8_per_token()),
+        "int8_per_channel": lambda: _saturating(_int8_per_channel()),
+        "int4_global": lambda: _saturating(_int4_global()),
+        "int4_per_token": lambda: _saturating(_int4_per_token()),
+        "int4_per_channel": lambda: _saturating(_int4_per_channel()),
+        "ternary_mean": lambda: _saturating(_ternary("mean")),
+        "ternary_max": lambda: _saturating(_ternary("max")),
+        "ternary_per_token": lambda: _saturating(_ternary_per_token()),
         "int4_per_token_pallas": _pallas("int4_per_token"),
         "int8_per_token_pallas": _pallas("int8_per_token"),
         "int8_per_channel_pallas": _pallas("int8_per_channel"),
@@ -377,7 +435,7 @@ def get_wire_codec(name: str) -> WireCodec:
 
 WIRE_CODECS = ("fp32", "bf16", "fp16", "int8_per_token", "int8_per_channel",
                "int4_global", "int4_per_token", "int4_per_channel",
-               "ternary_mean", "ternary_max",
+               "ternary_mean", "ternary_max", "ternary_per_token",
                "int4_per_token_pallas", "int8_per_token_pallas",
                "int8_per_channel_pallas", "int4_per_channel_pallas",
                "ternary_mean_pallas", "ternary_max_pallas")

@@ -546,7 +546,11 @@ def pallas_variant(codec: WireCodec, *, measured_wins_only: bool = False
     if codec.name in _PALLAS_FACTORIES:
         if measured_wins_only and not default_substituted(codec.name):
             return None
-        return _PALLAS_FACTORIES[codec.name]()
+        # the twins share the jnp codecs' pathological-input saturation, so
+        # kernel/jnp payload parity holds on sanitized inputs too
+        from .packing import _saturating
+
+        return _saturating(_PALLAS_FACTORIES[codec.name]())
     # selective_int4: no kernel twin exists — a measured deletion, not a gap
     # (SELECTIVE_EXCLUSION); the jnp codec is returned-as-is by the runtimes'
     # `pallas_variant(c) or c` fallback on every path, including forced

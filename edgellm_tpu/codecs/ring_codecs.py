@@ -47,7 +47,7 @@ import jax
 import jax.numpy as jnp
 
 from .packing import (WireCodec, _jnp_quant_pack, _jnp_unpack_dequant,
-                      selective_int4)
+                      selective_int4, _saturating, SATURATE_MAG)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -204,8 +204,11 @@ def ring_selective_int4(ratio: float, high: str = "bf16", *, n_seq: int,
 
     enc = encode_global if mode == "global" else local_base.encode
     dec = decode_global if mode == "global" else local_base.decode
-    return RingWireCodec(
+    # same pathological-input saturation as the dense codec (mode="local"
+    # inherits it via local_base; wrapping twice is an identity)
+    return _saturating(RingWireCodec(
         name=f"ring_selective_int4_r{ratio}_{high}_{mode}",
         encode=enc, decode=dec,
         batch_invariant=False, needs_importance=True,
-        ring_axis=axis_name, n_seq=n_seq, payload_bytes_fn=payload_bytes_fn)
+        ring_axis=axis_name, n_seq=n_seq, payload_bytes_fn=payload_bytes_fn),
+        min(SATURATE_MAG, float(jnp.finfo(high_dtype).max)))

@@ -15,7 +15,7 @@ from .harness import (
     run_initial_sweep,
     run_channel_sweep,
 )
-from .split_eval import run_split_eval, parse_hop_codec
+from .split_eval import run_split_eval, run_fault_sweep, parse_hop_codec
 
 __all__ = [
     "Chunk",
@@ -25,5 +25,6 @@ __all__ = [
     "run_initial_sweep",
     "run_channel_sweep",
     "run_split_eval",
+    "run_fault_sweep",
     "parse_hop_codec",
 ]

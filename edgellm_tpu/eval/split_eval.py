@@ -19,6 +19,7 @@ JSON checkpoint written every ``checkpoint_every`` chunks enables EXACT resume
 """
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Optional, Sequence
 
@@ -30,7 +31,8 @@ from ..models.configs import ModelConfig
 from ..models.transformer import nll_from_logits, run_layers_from_ids
 from ..importance import importance_per_layer
 from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
-from ..codecs.packing import WireCodec, selective_int4
+from ..codecs.packing import WireCodec, get_wire_codec, selective_int4
+from ..codecs.faults import FaultConfig, LinkPolicy, TierController, sum_counters
 from .harness import (ResumableDriver, _emit, _iter_window_groups,
                       _run_pipelined, fetch_global)
 
@@ -108,6 +110,8 @@ def run_split_eval(
     checkpoint_path: Optional[str] = None,
     checkpoint_every: int = 1000,
     metrics_path: Optional[str] = None,
+    faults: Optional[object] = None,
+    link_policy: Optional[object] = None,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
 
@@ -132,20 +136,63 @@ def run_split_eval(
     additionally sharded across it; a final partial group is padded up to the
     axis size with repeated windows whose loss weight is zero (the padding does
     cross the wire and is counted in the pushed-token/byte totals).
+
+    ``faults`` (a :class:`~edgellm_tpu.codecs.faults.FaultConfig` or kwargs
+    dict) turns the boundary wire faulty: every hop is sealed with the
+    integrity check, corrupted per the seeded rates, and handled per
+    ``link_policy`` (:class:`LinkPolicy` or dict). The chunk index is the fault
+    step, so a fixed seed corrupts the same hops of the same chunks on every
+    run. When ``link_policy.tiers`` names a codec ladder, a host-side
+    :class:`TierController` walks it: chunks whose hops report corruption step
+    the codecs down a tier (``degrade_after`` consecutive), clean chunks step
+    back up (``recover_after``) — the controller observes at drain time, so
+    under the two-deep submit pipeline a switch takes effect one group late.
+    Per-hop counters, the tier trail, and degraded-chunk totals land in the
+    result. Robustness state is per-run: a resumed run restarts counters and
+    the tier ladder at tier 0 (the checkpointed PPL partial sums stay exact).
     """
+    if isinstance(faults, dict):
+        faults = FaultConfig(**faults)
+    if isinstance(link_policy, dict):
+        link_policy = dataclasses.replace(
+            LinkPolicy(**link_policy),
+            tiers=tuple(link_policy.get("tiers", ())))
+    fault_on = faults is not None and faults.enabled
+    policy = link_policy if link_policy is not None else LinkPolicy()
     codecs = [parse_hop_codec(c, n_seq) if isinstance(c, str) else c
               for c in hop_codecs]
     split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(codecs))
     if n_seq > 1:
-        from ..parallel.ring import SplitRingRuntime, make_sp_stage_mesh
+        from ..parallel.ring import make_sp_stage_mesh
 
         if mesh is None:
             mesh = make_sp_stage_mesh(split.n_stages, n_seq)
-        rt = SplitRingRuntime(cfg, split.cuts, codecs, mesh)
-    else:
-        if mesh is None:
-            mesh = make_stage_mesh(split.n_stages)
-        rt = SplitRuntime(cfg, split, mesh)
+    elif mesh is None:
+        mesh = make_stage_mesh(split.n_stages)
+
+    def _make_runtime(tier_codecs):
+        if n_seq > 1:
+            from ..parallel.ring import SplitRingRuntime
+
+            return SplitRingRuntime(cfg, split.cuts, list(tier_codecs), mesh,
+                                    faults=faults, policy=link_policy)
+        return SplitRuntime(
+            cfg, SplitConfig(cuts=split.cuts, hop_codecs=tuple(tier_codecs)),
+            mesh, faults=faults, policy=link_policy)
+
+    # tier 0 is the configured codec set; lower tiers swap EVERY hop to one
+    # uniform fallback codec (payload shapes change, hence separate runtimes
+    # — parameter placement is codec-independent, so ``placed`` is shared)
+    ladder = [list(codecs)]
+    controller = None
+    if fault_on and policy.tiers:
+        for name in policy.tiers:
+            get_wire_codec(name)  # fail fast on a bad ladder entry
+            ladder.append([name] * len(codecs))
+        controller = TierController(len(ladder), policy.degrade_after,
+                                    policy.recover_after)
+    runtimes = {0: _make_runtime(ladder[0])}
+    rt = runtimes[0]
     placed = rt.place_params(params)
     needs_imp = [c.needs_importance for c in rt.codecs]
     if any(needs_imp) and importance_method is None:
@@ -184,6 +231,12 @@ def run_split_eval(
         "window_batch": int(window_batch), "n_seq": int(n_seq),
         "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
     }
+    if fault_on:
+        # a checkpoint written under one fault regime must not silently resume
+        # under another (JSON round-trips lists, so tuples are listified here)
+        axes["faults"] = dataclasses.asdict(faults)
+        axes["link_policy"] = {**dataclasses.asdict(policy),
+                               "tiers": list(policy.tiers)}
     rd = ResumableDriver(checkpoint_path, axes, checkpoint_every)
     total_nll, n_tokens = 0.0, 0.0
     fwd_tokens = 0  # every token pushed through the pipeline (incl. overlap/pad)
@@ -201,6 +254,8 @@ def run_split_eval(
                  "hop_bytes_total": hop_bytes_total})
 
     bytes_cache: dict = {}
+    degraded_chunks = 0  # chunks that ran below tier 0
+    tier_log: list = []  # (chunk_index, tier) at every controller switch
 
     def submit_group(group):
         n_real = len(group)
@@ -220,20 +275,34 @@ def run_split_eval(
             ids = np.pad(ids, ((0, 0), (0, pad)))
             targets = np.pad(targets, ((0, 0), (0, pad)), constant_values=-100)
         ids, targets = jnp.asarray(ids), jnp.asarray(targets)
-        if imp_fn is not None:
+        tier = controller.tier if controller is not None else 0
+        if tier not in runtimes:  # built on first demand, cached thereafter
+            runtimes[tier] = _make_runtime(ladder[tier])
+        art = runtimes[tier]
+        # the chunk index drives the fault stream: same seed => same chunks
+        # corrupted, run after run (ignored when the link is off)
+        fstep = group[0].index
+        needs_t = [c.needs_importance for c in art.codecs]
+        if imp_fn is not None and any(needs_t):
             imp = imp_fn(params, ids, hw)  # (L, W, S)
             hop_imp = [(imp[cut] if len(group) > 1 else imp[cut, 0]) if need
                        else None
-                       for cut, need in zip(split.cuts, needs_imp)]
-            logits = rt.forward(placed, ids, hop_importance=hop_imp)
+                       for cut, need in zip(split.cuts, needs_t)]
+            logits = art.forward(placed, ids, hop_importance=hop_imp,
+                                 fault_step=fstep)
         else:
-            logits = rt.forward(placed, ids)
+            logits = art.forward(placed, ids, fault_step=fstep)
+        # this chunk's (still on-device) counters, for the tier controller
+        chunk_counters = art._counter_accum[-1] if fault_on else None
         nlls = nll_from_logits(logits, targets, per_example=True)
-        return group, n_real, s_unpadded, counts, ids.shape, nlls
+        return (group, n_real, s_unpadded, counts, ids.shape, nlls, tier,
+                chunk_counters)
 
     def drain_group(rec):
         nonlocal total_nll, n_tokens, fwd_tokens, real_fwd_tokens
-        group, n_real, s_unpadded, counts, (w, s_chunk), nlls = rec
+        nonlocal degraded_chunks
+        (group, n_real, s_unpadded, counts, (w, s_chunk), nlls, tier,
+         chunk_counters) = rec
         # the per-example NLLs ride the mesh's data axis, which is the one
         # axis allowed to span processes in a multi-host run
         total_nll += float(fetch_global(nlls).astype(np.float64)
@@ -241,20 +310,32 @@ def run_split_eval(
         n_tokens += sum(counts)
         fwd_tokens += w * s_chunk
         real_fwd_tokens += n_real * s_unpadded
-        key = (w, s_chunk)
+        key = (tier, w, s_chunk)
         if key not in bytes_cache:  # payloads are shape-determined
-            bytes_cache[key] = rt.hop_bytes(w, s_chunk)
+            bytes_cache[key] = runtimes[tier].hop_bytes(w, s_chunk)
         for i, b in enumerate(bytes_cache[key]):
             hop_bytes_total[i] += b
+        if tier:
+            degraded_chunks += 1
+        if controller is not None:
+            corrupted = any(
+                int(np.asarray(chunk_counters[k]).sum())
+                for k in ("detected", "budget_dropped"))
+            prev = controller.tier
+            if controller.observe(corrupted) != prev:
+                tier_log.append((group[-1].index, controller.tier))
         if progress:
             progress(group[-1].index)
         if rd.advance(group, count=n_real):
             save_checkpoint()
-            _emit(metrics_path, {
+            rec_out = {
                 "chunk": group[-1].index, "chunks": rd.chunks,
                 "n_tokens": n_tokens,
                 "ppl": float(np.exp(total_nll / max(n_tokens, 1e-9))),
-                "hop_bytes_total": hop_bytes_total})
+                "hop_bytes_total": hop_bytes_total}
+            if fault_on:
+                rec_out["tier"] = tier
+            _emit(metrics_path, rec_out)
 
     _run_pipelined(
         _iter_window_groups(token_ids, max_length, stride,
@@ -291,11 +372,72 @@ def run_split_eval(
         "real_tokens_per_s": real_fwd_tokens / max(wall, 1e-9),
         "mesh": dict(mesh.shape),
     }
+    if fault_on:
+        agg = None  # per-hop counters summed over every tier's runtime
+        for r in runtimes.values():
+            c = r.link_counters()
+            if c is None:
+                continue
+            if agg is None:
+                agg = {k: v.copy() for k, v in c.items()}
+            else:
+                for k in agg:
+                    agg[k] += c[k]
+        result["faults"] = dataclasses.asdict(faults)
+        result["link_policy"] = {**dataclasses.asdict(policy),
+                                 "tiers": list(policy.tiers)}
+        result["link_counters"] = {k: [int(x) for x in v]
+                                   for k, v in (agg or {}).items()}
+        result["tier_ladder"] = [[c if isinstance(c, str) else c.name
+                                  for c in t] for t in ladder]
+        result["tier_switches"] = [list(t) for t in tier_log]
+        result["final_tier"] = controller.tier if controller is not None else 0
+        result["degraded_chunks"] = degraded_chunks
     if time_hops and rd.chunks:
         t_seq = seq if n_seq <= 1 else seq + (-seq) % n_seq
         result["per_hop_ms"] = rt.time_hops(1, t_seq)
-    _emit(metrics_path, {"final": True, "chunks": rd.chunks, "n_tokens": n_tokens,
-                         "ppl": result["ppl"], "wall_s": wall,
-                         "hop_bytes_total": hop_bytes_total,
-                         "pad_fraction": result["pad_fraction"]})
+    final_rec = {"final": True, "chunks": rd.chunks, "n_tokens": n_tokens,
+                 "ppl": result["ppl"], "wall_s": wall,
+                 "hop_bytes_total": hop_bytes_total,
+                 "pad_fraction": result["pad_fraction"]}
+    if fault_on:
+        final_rec["link_counters"] = result["link_counters"]
+        final_rec["degraded_chunks"] = degraded_chunks
+    _emit(metrics_path, final_rec)
     return result
+
+
+def run_fault_sweep(
+    cfg: ModelConfig,
+    params,
+    token_ids: np.ndarray,
+    *,
+    rates: Sequence[float],
+    knob: str = "drop_rate",
+    seed: int = 0,
+    byte_budget: Optional[int] = None,
+    link_policy: Optional[object] = None,
+    **eval_kwargs,
+) -> list:
+    """PPL / throughput / counter curve as a function of fault rate.
+
+    Runs :func:`run_split_eval` once per entry of ``rates``, setting ``knob``
+    (``"drop_rate"``, ``"bitflip_rate"``, or ``"scale_corrupt_rate"``) on a
+    fresh :class:`FaultConfig` each time. Rate 0 with no ``byte_budget`` runs
+    the plain fault-free graph — the sweep's exact baseline point. Each result
+    dict gains ``fault_knob`` / ``fault_rate``; remaining kwargs pass through
+    (cuts, hop_codecs, max_length, stride, ...).
+    """
+    if knob not in ("drop_rate", "bitflip_rate", "scale_corrupt_rate"):
+        raise ValueError(f"unknown fault knob {knob!r}")
+    out = []
+    for r in rates:
+        fc = FaultConfig(**{knob: float(r)}, byte_budget=byte_budget,
+                         seed=seed)
+        res = run_split_eval(cfg, params, token_ids,
+                             faults=fc if fc.enabled else None,
+                             link_policy=link_policy, **eval_kwargs)
+        res["fault_knob"] = knob
+        res["fault_rate"] = float(r)
+        out.append(res)
+    return out

@@ -16,10 +16,56 @@ Layout notes:
 """
 from __future__ import annotations
 
+import os
+import time
+
 import numpy as np
 import jax.numpy as jnp
 
 from .configs import ModelConfig
+
+
+def fetch_with_retry(url: str, dest: str, *, max_retries: int = 4,
+                     timeout: float = 30.0, backoff: float = 1.0,
+                     _sleep=time.sleep) -> str:
+    """Download ``url`` to ``dest`` with bounded retries and exponential
+    backoff — the edge-network counterpart of the wire-fault layer: flaky
+    checkpoint links get ``max_retries`` re-attempts (waiting ``backoff * 2**n``
+    seconds between them), permanent HTTP client errors (4xx) fail immediately,
+    and the final error says exactly what to do next. The download lands in a
+    temp file and is renamed into place, so a cut connection never leaves a
+    truncated ``dest`` behind. stdlib urllib only — no new dependencies."""
+    import urllib.error
+    import urllib.request
+
+    if max_retries < 0:
+        raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+    os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+    tmp = dest + ".part"
+    last_err = None
+    for attempt in range(max_retries + 1):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r, \
+                    open(tmp, "wb") as f:
+                while chunk := r.read(1 << 20):
+                    f.write(chunk)
+            os.replace(tmp, dest)
+            return dest
+        except urllib.error.HTTPError as e:
+            if e.code < 500:  # 4xx is permanent; retrying can't fix a 404
+                raise RuntimeError(
+                    f"fetch of {url} failed permanently (HTTP {e.code} "
+                    f"{e.reason}); check the URL/revision, or download the "
+                    f"file manually and pass its local path") from e
+            last_err = e
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            last_err = e
+        if attempt < max_retries:
+            _sleep(backoff * (2 ** attempt))
+    raise RuntimeError(
+        f"fetch of {url} failed after {max_retries + 1} attempts "
+        f"(last error: {last_err}); the link may be down — retry later, or "
+        f"download the file manually and pass its local path") from last_err
 
 
 def _np(t):

@@ -47,8 +47,81 @@ def _bf16_to_f32(raw: np.ndarray) -> np.ndarray:
     return (raw.astype(np.uint32) << 16).view(np.float32)
 
 
+_DTYPE_BYTES = {"F64": 8, "F32": 4, "F16": 2, "BF16": 2, "I64": 8, "I32": 4,
+                "I16": 2, "I8": 1, "U8": 1, "BOOL": 1}
+
+
+def _parse_header(f, path: str):
+    """(header dict, data-section byte length), or ValueError saying exactly
+    what is malformed — a truncated download dies here, not in numpy."""
+    size = os.fstat(f.fileno()).st_size
+    head = f.read(8)
+    if len(head) < 8:
+        raise ValueError(f"{path}: not a safetensors file — only {size} bytes "
+                         f"(needs an 8-byte header length); re-download it")
+    (header_len,) = struct.unpack("<Q", head)
+    if header_len == 0 or 8 + header_len > size:
+        raise ValueError(
+            f"{path}: corrupt safetensors — header claims {header_len} bytes "
+            f"but the file holds {size}; the download is likely truncated, "
+            f"re-fetch it")
+    try:
+        header = json.loads(f.read(header_len))
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ValueError(f"{path}: corrupt safetensors — header is not valid "
+                         f"JSON ({e}); re-download the file") from e
+    if not isinstance(header, dict):
+        raise ValueError(f"{path}: corrupt safetensors — header must be a "
+                         f"JSON object, got {type(header).__name__}")
+    return header, size - 8 - header_len
+
+
+def verify_safetensors_integrity(path: str) -> dict:
+    """Structural integrity check of one ``.safetensors`` file, BEFORE any
+    tensor is materialized: the header parses, every tensor's dtype is known,
+    its ``data_offsets`` lie inside the data section in order, and the byte
+    span matches ``prod(shape) * itemsize`` exactly. Returns
+    ``{"tensors": n, "data_bytes": n}``; raises ValueError with an actionable
+    message (which tensor, what mismatch) on the first inconsistency.
+    :func:`read_safetensors` runs this on every load."""
+    with open(path, "rb") as f:
+        header, data_bytes = _parse_header(f, path)
+    n = 0
+    end_prev = 0
+    entries = [(name, meta) for name, meta in header.items()
+               if name != "__metadata__"]
+    # safetensors stores tensors contiguously in offset order; validate in
+    # that order so overlaps and gaps are caught, not just bounds
+    for name, meta in sorted(entries, key=lambda kv: kv[1]["data_offsets"][0]):
+        itemsize = _DTYPE_BYTES.get(meta.get("dtype"))
+        if itemsize is None:
+            raise ValueError(f"{path}: tensor {name!r} has unsupported dtype "
+                             f"{meta.get('dtype')!r}")
+        start, end = meta["data_offsets"]
+        want = int(np.prod(meta["shape"], dtype=np.int64)) * itemsize
+        if not 0 <= start <= end <= data_bytes:
+            raise ValueError(
+                f"{path}: tensor {name!r} data_offsets [{start}, {end}) fall "
+                f"outside the {data_bytes}-byte data section — truncated or "
+                f"corrupt download, re-fetch the file")
+        if end - start != want:
+            raise ValueError(
+                f"{path}: tensor {name!r} spans {end - start} bytes but shape "
+                f"{meta['shape']} x {meta['dtype']} needs {want} — header and "
+                f"data disagree, the file is corrupt")
+        if start < end_prev:
+            raise ValueError(f"{path}: tensor {name!r} overlaps the previous "
+                             f"tensor's bytes — the file is corrupt")
+        end_prev = end
+        n += 1
+    return {"tensors": n, "data_bytes": data_bytes}
+
+
 def read_safetensors(path: str) -> dict:
-    """Parse one ``.safetensors`` file into {name: np.ndarray} (bf16 -> fp32)."""
+    """Parse one ``.safetensors`` file into {name: np.ndarray} (bf16 -> fp32).
+    The structural integrity check runs first, so a truncated or bit-rotted
+    checkpoint raises an actionable error instead of loading garbage."""
+    verify_safetensors_integrity(path)
     with open(path, "rb") as f:
         (header_len,) = struct.unpack("<Q", f.read(8))
         header = json.loads(f.read(header_len))

@@ -315,12 +315,21 @@ class SplitRingRuntime:
     codecs are rejected.
     """
 
-    def __init__(self, cfg: ModelConfig, cuts, hop_codecs, mesh: Mesh):
+    def __init__(self, cfg: ModelConfig, cuts, hop_codecs, mesh: Mesh,
+                 faults=None, policy=None):
         from .split import SplitConfig, apply_default_codec_backend
         from ..codecs.ring_codecs import RingWireCodec
+        from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy
 
         self.cfg = cfg
         self.mesh = mesh
+        self.faults = faults
+        self.policy = policy if policy is not None else LinkPolicy()
+        # same activation rule as SplitRuntime: zero rates build the exact
+        # fault-free graph
+        self._link = (FaultyLink(faults, self.policy)
+                      if faults is not None and faults.enabled else None)
+        self._counter_accum: list = []
         self.split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(hop_codecs))
         self.codecs = apply_default_codec_backend(list(self.split.hop_codecs))
         bad = [c.name for c in self.codecs
@@ -373,9 +382,10 @@ class SplitRingRuntime:
 
         cfg, n_stages = self.cfg, self.split.n_stages
         codecs, mesh = self.codecs, self.mesh
+        link = self._link
 
         def body(local_layers, local_valid, other, ids_loc, cos_loc, sin_loc,
-                 hop_imps):
+                 hop_imps, fault_step=None):
             lv = {k: v[0] for k, v in local_layers.items()}
             valid = local_valid[0]
             hidden = embed(other, ids_loc)  # (B, S_loc, D), seq-sharded
@@ -393,12 +403,25 @@ class SplitRingRuntime:
             # (per-token codecs encode shard-locally == full-sequence encode;
             # ring-aware selective codecs agree on ordering/scale via their
             # own small collectives over "seq")
-            hidden = run_pipeline_stages(n_stages, codecs, run_stage, hidden,
-                                         hop_imps)
-            return unembed(cfg, other, hidden)
+            if link is None:
+                hidden = run_pipeline_stages(n_stages, codecs, run_stage,
+                                             hidden, hop_imps)
+                return unembed(cfg, other, hidden)
+            # each seq shard ships its OWN payload across the cut, so each
+            # gets its own fault stream (fold the shard index into the key);
+            # counters then sum over both axes — stage hops x seq shards
+            key = jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(link.faults.seed),
+                                   fault_step),
+                jax.lax.axis_index("seq"))
+            hidden, counters = run_pipeline_stages(
+                n_stages, codecs, run_stage, hidden, hop_imps,
+                link=link, fault_key=key)
+            counters = {k: jax.lax.psum(v, "seq") for k, v in counters.items()}
+            return unembed(cfg, other, hidden), counters
 
         @jax.jit
-        def fn(placed, input_ids, hop_imps):
+        def fn(placed, input_ids, hop_imps, fault_step=None):
             seq = input_ids.shape[1]
             if seq % mesh.shape["seq"]:
                 raise ValueError(f"sequence length {seq} not divisible by seq "
@@ -410,14 +433,23 @@ class SplitRingRuntime:
             # importance shards ride the seq axis on the token dimension, like
             # the hidden: (n_hops, B, S) or (n_hops, S)
             imp_spec = P(None, None, "seq") if hop_imps.ndim == 3 else P(None, "seq")
+            if link is None:
+                return shard_map(
+                    body, mesh=mesh,
+                    in_specs=(lspecs, P("stage"), P(), P(None, "seq"), P("seq"),
+                              P("seq"), imp_spec),
+                    out_specs=P(None, "seq"),
+                    check_vma=False,
+                )(placed["layers"], placed["layers_valid"], other, input_ids,
+                  cos, sin, hop_imps)
             return shard_map(
                 body, mesh=mesh,
                 in_specs=(lspecs, P("stage"), P(), P(None, "seq"), P("seq"),
-                          P("seq"), imp_spec),
-                out_specs=P(None, "seq"),
+                          P("seq"), imp_spec, P()),
+                out_specs=(P(None, "seq"), P()),
                 check_vma=False,
             )(placed["layers"], placed["layers_valid"], other, input_ids,
-              cos, sin, hop_imps)
+              cos, sin, hop_imps, fault_step)
 
         return fn
 
@@ -446,7 +478,8 @@ class SplitRingRuntime:
                                  iters=iters, hidden_spec=P(None, "seq"))
 
     def forward(self, placed_params: dict, input_ids,
-                hop_importance: Optional[list] = None) -> jnp.ndarray:
+                hop_importance: Optional[list] = None,
+                fault_step: int = 0) -> jnp.ndarray:
         """ids (B, S) -> full fp32 logits; layers stage-split, sequence
         ring-sharded, boundary hops carry packed per-token payload shards.
 
@@ -454,7 +487,12 @@ class SplitRingRuntime:
         selective codecs (``needs_importance``); arrays may be global
         seq-sharded outputs of :func:`importance_sp` — the runtime shards them
         over "seq" alongside the hidden, and the codec's own collectives
-        reconstruct the global ordering."""
+        reconstruct the global ordering.
+
+        ``fault_step``: per-call fault-PRNG fold (see
+        ``SplitRuntime.forward``); each sequence shard additionally folds its
+        shard index, so shards draw independent faults. Counters accumulate on
+        the runtime — read with :meth:`link_counters`."""
         input_ids = jnp.asarray(input_ids)
         batch, seq = input_ids.shape
         n_hops = len(self.codecs)
@@ -480,4 +518,25 @@ class SplitRingRuntime:
                               else jnp.broadcast_to(jnp.asarray(i, jnp.float32),
                                                     blank.shape)
                               for i in imps]))
-        return self._forward(placed_params, input_ids, stacked)
+        if self._link is None:
+            return self._forward(placed_params, input_ids, stacked)
+        logits, counters = self._forward(placed_params, input_ids, stacked,
+                                         jnp.asarray(fault_step, jnp.int32))
+        self._counter_accum.append(counters)
+        return logits
+
+    def link_counters(self, reset: bool = False) -> Optional[dict]:
+        """Per-hop fault counters summed over all forward calls and all
+        sequence shards: {name: (n_hops,) int64}. None when faults are off."""
+        from ..codecs.faults import sum_counters
+
+        if self._link is None:
+            return None
+        tot = sum_counters(self._counter_accum)
+        if tot is None:
+            n_hops = len(self.codecs)
+            tot = {k: np.zeros((n_hops,), np.int64)
+                   for k in self._link.init_counters(n_hops)}
+        if reset:
+            self._counter_accum = []
+        return tot

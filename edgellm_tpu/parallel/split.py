@@ -37,6 +37,7 @@ from ..models.configs import ModelConfig
 from ..models.transformer import (block, block_decode, embed, unembed,
                                   precompute_rope, KVCache)
 from ..codecs.packing import get_wire_codec, WireCodec
+from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
 from ..utils.jax_compat import shard_map, pcast_varying
 
 
@@ -94,7 +95,8 @@ def regroup_layers(layers: dict, bounds: list, stage_size: int) -> tuple:
 
 
 def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
-                        hop_imps=None, axis_name: str = "stage"):
+                        hop_imps=None, axis_name: str = "stage",
+                        link=None, fault_key=None):
     """The pipeline-unroll + boundary-hop protocol, shared by SplitRuntime and
     the stage x seq SplitRingRuntime (must run inside shard_map on
     ``axis_name``).
@@ -104,12 +106,25 @@ def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
     each cut the boundary activation is ENCODED to a packed payload, crossed to
     the next device via ``ppermute``, and DECODED on arrival. The final psum
     replicates the last stage's output structurally (no vma typing needed for
-    Pallas-backed codecs)."""
+    Pallas-backed codecs).
+
+    ``link`` (a :class:`~edgellm_tpu.codecs.faults.FaultyLink`) reroutes every
+    hop through the faulty-wire protocol — seal, inject, verify, retry — keyed
+    by ``fault_key``; the return value then becomes ``(out, counters)`` with
+    the per-hop counters psum-replicated over ``axis_name``. With ``link``
+    None this is byte-for-byte the original lossless path."""
     idx = jax.lax.axis_index(axis_name)
+    counters = link.init_counters(n_stages - 1) if link is not None else None
     for s in range(n_stages):
         computed = run_stage(hidden)
         hidden = jnp.where(idx == s, computed, hidden)
         if s < n_stages - 1:
+            if link is not None:
+                imp = hop_imps[s] if codecs[s].needs_importance else None
+                hidden, counters = link.hop(codecs[s], hidden, s, axis_name,
+                                            idx, fault_key, counters,
+                                            hop_imp=imp)
+                continue
             if codecs[s].needs_importance:
                 payload = codecs[s].encode(hidden, hop_imps[s])
             else:
@@ -117,20 +132,27 @@ def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
             moved = jax.tree_util.tree_map(
                 lambda a: jax.lax.ppermute(a, axis_name, [(s, s + 1)]), payload)
             hidden = jnp.where(idx == s + 1, codecs[s].decode(moved), hidden)
-    return jax.lax.psum(
+    out = jax.lax.psum(
         jnp.where(idx == n_stages - 1, hidden, jnp.zeros_like(hidden)), axis_name)
+    if link is None:
+        return out
+    counters = {k: jax.lax.psum(v, axis_name) for k, v in counters.items()}
+    return out, counters
 
 
 def run_pipeline_stages_carry(n_stages: int, codecs: list, run_stage, hidden,
-                              carry, axis_name: str = "stage"):
+                              carry, axis_name: str = "stage",
+                              link=None, fault_key=None):
     """:func:`run_pipeline_stages` for stage bodies that thread stage-local
     state (the decode KV cache): ``run_stage(hidden, carry) -> (hidden,
     carry)``. Each device keeps the carry produced at ITS unroll step — the
     step where the hidden it transformed was the real pipeline activation —
     so per-stage caches update exactly once per token, and nothing but the
     (B, 1, D) boundary activation ever crosses a cut. Returns
-    (final hidden, carry)."""
+    (final hidden, carry), plus the psum-replicated fault counters when
+    ``link`` is given (see :func:`run_pipeline_stages`)."""
     idx = jax.lax.axis_index(axis_name)
+    counters = link.init_counters(n_stages - 1) if link is not None else None
     for s in range(n_stages):
         computed, new_carry = run_stage(hidden, carry)
         keep = idx == s
@@ -138,13 +160,20 @@ def run_pipeline_stages_carry(n_stages: int, codecs: list, run_stage, hidden,
         carry = jax.tree_util.tree_map(
             lambda new, old: jnp.where(keep, new, old), new_carry, carry)
         if s < n_stages - 1:
+            if link is not None:
+                hidden, counters = link.hop(codecs[s], hidden, s, axis_name,
+                                            idx, fault_key, counters)
+                continue
             payload = codecs[s].encode(hidden)
             moved = jax.tree_util.tree_map(
                 lambda a: jax.lax.ppermute(a, axis_name, [(s, s + 1)]), payload)
             hidden = jnp.where(idx == s + 1, codecs[s].decode(moved), hidden)
     out = jax.lax.psum(
         jnp.where(idx == n_stages - 1, hidden, jnp.zeros_like(hidden)), axis_name)
-    return out, carry
+    if link is None:
+        return out, carry
+    counters = {k: jax.lax.psum(v, axis_name) for k, v in counters.items()}
+    return out, carry, counters
 
 
 def hop_payload_bytes(codecs, cfg, batch: int, seq: int) -> list:
@@ -246,10 +275,19 @@ class SplitRuntime:
         rt.hop_bytes(batch, seq)                  # measured payload bytes per hop
     """
 
-    def __init__(self, cfg: ModelConfig, split: SplitConfig, mesh: Mesh):
+    def __init__(self, cfg: ModelConfig, split: SplitConfig, mesh: Mesh,
+                 faults: Optional[FaultConfig] = None,
+                 policy: Optional[LinkPolicy] = None):
         self.cfg = cfg
         self.split = split
         self.mesh = mesh
+        self.faults = faults
+        self.policy = policy if policy is not None else LinkPolicy()
+        # an all-zero-rate config builds the exact fault-free graph: the link
+        # machinery only exists in the jaxpr when a fault can actually fire
+        self._link = (FaultyLink(faults, self.policy)
+                      if faults is not None and faults.enabled else None)
+        self._counter_accum: list = []
         self.bounds = split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
         self.codecs: list[WireCodec] = apply_default_codec_backend(
@@ -327,10 +365,12 @@ class SplitRuntime:
         cfg, n_stages, sz = self.cfg, self.split.n_stages, self.stage_size
         codecs = self.codecs
         mesh = self.mesh
+        link = self._link
 
         tp_axis = "model" if mesh.shape["model"] > 1 else None
 
-        def stage_body(local_layers, local_valid, hidden, cos, sin, hop_imps):
+        def stage_body(local_layers, local_valid, hidden, cos, sin, hop_imps,
+                       fault_step=None):
             """Runs inside shard_map: one device = one pipeline stage (and one
             tensor-parallel shard of it when the "model" axis is populated)."""
             lv = {k: v[0] for k, v in local_layers.items()}  # (sz, ...)
@@ -349,7 +389,15 @@ class SplitRuntime:
                 computed, _ = jax.lax.scan(scan_body, h, (lv, valid))
                 return computed
 
-            return run_pipeline_stages(n_stages, codecs, run_stage, hidden, hop_imps)
+            if link is None:
+                return run_pipeline_stages(n_stages, codecs, run_stage, hidden,
+                                           hop_imps)
+            # one fold per forward call keeps chunks decorrelated while two
+            # same-seed runs replay the identical fault sequence
+            key = jax.random.fold_in(jax.random.key(link.faults.seed),
+                                     fault_step)
+            return run_pipeline_stages(n_stages, codecs, run_stage, hidden,
+                                       hop_imps, link=link, fault_key=key)
 
         # batch axis rides the "data" mesh axis (data parallelism over evaluation
         # windows); each data-parallel group runs the full pipeline over "stage"
@@ -358,7 +406,7 @@ class SplitRuntime:
         layer_pspec = self._layer_pspec
 
         @jax.jit
-        def fn(placed, input_ids, hop_imps):
+        def fn(placed, input_ids, hop_imps, fault_step=None):
             hidden = embed(placed, input_ids)
             cos, sin = precompute_rope(cfg, input_ids.shape[1])
             lspecs = {k: layer_pspec(k, v.ndim) for k, v in placed["layers"].items()}
@@ -366,22 +414,35 @@ class SplitRuntime:
             # shared (H, S) importance is replicated (ndim is static under jit)
             imp_spec = (P(None, "data") if hop_imps.ndim == 3
                         and mesh.shape["data"] > 1 else P())
-            out = shard_map(
+            if link is None:
+                out = shard_map(
+                    stage_body,
+                    mesh=mesh,
+                    in_specs=(lspecs, P("stage"), batch_spec, P(), P(), imp_spec),
+                    out_specs=batch_spec,
+                    # vma tracking cannot type pallas_call outputs inside the body
+                    # (hop codecs may be Pallas kernels); replication is enforced
+                    # structurally by the final psum instead
+                    check_vma=False,
+                )(placed["layers"], placed["layers_valid"], hidden, cos, sin,
+                  hop_imps)
+                return unembed(cfg, placed, out)
+            out, counters = shard_map(
                 stage_body,
                 mesh=mesh,
-                in_specs=(lspecs, P("stage"), batch_spec, P(), P(), imp_spec),
-                out_specs=batch_spec,
-                # vma tracking cannot type pallas_call outputs inside the body
-                # (hop codecs may be Pallas kernels); replication is enforced
-                # structurally by the final psum instead
+                in_specs=(lspecs, P("stage"), batch_spec, P(), P(), imp_spec,
+                          P()),
+                out_specs=(batch_spec, P()),
                 check_vma=False,
-            )(placed["layers"], placed["layers_valid"], hidden, cos, sin, hop_imps)
-            return unembed(cfg, placed, out)
+            )(placed["layers"], placed["layers_valid"], hidden, cos, sin,
+              hop_imps, fault_step)
+            return unembed(cfg, placed, out), counters
 
         return fn
 
     def forward(self, placed_params: dict, input_ids: jnp.ndarray,
-                hop_importance: Optional[Sequence] = None) -> jnp.ndarray:
+                hop_importance: Optional[Sequence] = None,
+                fault_step: int = 0) -> jnp.ndarray:
         """ids -> fp32 logits, with every cut crossed as a packed ppermute.
 
         ``hop_importance``: per-hop token-importance entries, required when any
@@ -390,7 +451,12 @@ class SplitRuntime:
         batching evaluation windows — (B, S) so every window keeps its OWN
         ordering and codec scale (the reference selects per window at batch 1,
         ``Qwen2-0.5B/main.py:161-165``; with the "data" mesh axis populated the
-        rows ride it alongside the hidden batch)."""
+        rows ride it alongside the hidden batch).
+
+        ``fault_step``: the fault layer's per-call PRNG fold (pass the chunk
+        index so each chunk draws distinct faults; a traced scalar, so it
+        never retraces). Ignored when faults are off. Per-hop fault counters
+        accumulate on the runtime — read them with :meth:`link_counters`."""
         n_hops = len(self.codecs)
         batch, seq = input_ids.shape
         imps = list(hop_importance) if hop_importance is not None else [None] * n_hops
@@ -415,7 +481,28 @@ class SplitRuntime:
                               else jnp.broadcast_to(jnp.asarray(i, jnp.float32),
                                                     blank.shape)
                               for i in imps]))
-        return self._forward(placed_params, input_ids, stacked)
+        if self._link is None:
+            return self._forward(placed_params, input_ids, stacked)
+        logits, counters = self._forward(placed_params, input_ids, stacked,
+                                         jnp.asarray(fault_step, jnp.int32))
+        self._counter_accum.append(counters)
+        return logits
+
+    def link_counters(self, reset: bool = False) -> Optional[dict]:
+        """Per-hop fault counters accumulated over every forward/prefill/step
+        call so far: {name: (n_hops,) int64}. None when faults are off.
+        Reading forces a sync of the pending counter arrays — call it at
+        reporting boundaries, not per chunk."""
+        if self._link is None:
+            return None
+        tot = sum_counters(self._counter_accum)
+        if tot is None:
+            n_hops = len(self.codecs)
+            tot = {k: np.zeros((n_hops,), np.int64)
+                   for k in self._link.init_counters(n_hops)}
+        if reset:
+            self._counter_accum = []
+        return tot
 
     # ---------- incremental decode ----------
     #
@@ -446,8 +533,21 @@ class SplitRuntime:
         cfg, n_stages, sz = self.cfg, self.split.n_stages, self.stage_size
         codecs, mesh = self.codecs, self.mesh
         layer_pspec = self._layer_pspec
+        link = self._link
 
-        def stage_prefill(local_layers, local_valid, hidden, cos, sin):
+        def _hop_protocol(run_stage, hidden, carry, fault_key):
+            """Dispatch the carry protocol with or without the faulty link —
+            the link-free branch is byte-for-byte the original call."""
+            if link is None:
+                out, c = run_pipeline_stages_carry(
+                    n_stages, codecs, run_stage, hidden, carry)
+                return out, c, None
+            return run_pipeline_stages_carry(
+                n_stages, codecs, run_stage, hidden, carry,
+                link=link, fault_key=fault_key)
+
+        def stage_prefill(local_layers, local_valid, hidden, cos, sin,
+                          fault_step=None):
             lv = {k: v[0] for k, v in local_layers.items()}  # (sz, ...)
             valid = local_valid[0]
             s = hidden.shape[1]
@@ -467,9 +567,14 @@ class SplitRuntime:
                 return computed, (kc.at[:, :, :s].set(ks),
                                   vc.at[:, :, :s].set(vs))
 
-            out, (kc, vc) = run_pipeline_stages_carry(
-                n_stages, codecs, run_stage, hidden, (zeros, zeros))
-            return out, kc[None], vc[None]
+            fkey = None if link is None else jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(link.faults.seed), 0x9EF1),
+                fault_step)
+            out, (kc, vc), counters = _hop_protocol(
+                run_stage, hidden, (zeros, zeros), fkey)
+            if link is None:
+                return out, kc[None], vc[None]
+            return out, kc[None], vc[None], counters
 
         def stage_step(local_layers, local_valid, hidden, k_loc, v_loc,
                        cos_t, sin_t, pos):
@@ -491,23 +596,39 @@ class SplitRuntime:
                                               (lv, valid, kc, vc))
                 return h2, (kc2, vc2)
 
-            out, (kc, vc) = run_pipeline_stages_carry(
-                n_stages, codecs, run_stage, hidden, (k_loc[0], v_loc[0]))
-            return out, kc[None], vc[None]
+            # the cache fill level is the fault step: distinct per emitted
+            # token, identical across same-seed runs, no extra traced arg
+            fkey = None if link is None else jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(link.faults.seed), 0x57E9),
+                pos)
+            out, (kc, vc), counters = _hop_protocol(
+                run_stage, hidden, (k_loc[0], v_loc[0]), fkey)
+            if link is None:
+                return out, kc[None], vc[None]
+            return out, kc[None], vc[None], counters
 
         @jax.jit
-        def prefill_fn(placed, input_ids):
+        def prefill_fn(placed, input_ids, fault_step=None):
             hidden = embed(placed, input_ids)
             cos, sin = precompute_rope(cfg, input_ids.shape[1])
             lspecs = {k: layer_pspec(k, v.ndim)
                       for k, v in placed["layers"].items()}
-            out, kc, vc = shard_map(
+            if link is None:
+                out, kc, vc = shard_map(
+                    stage_prefill, mesh=mesh,
+                    in_specs=(lspecs, P("stage"), P(), P(), P()),
+                    out_specs=(P(), P("stage"), P("stage")),
+                    check_vma=False,
+                )(placed["layers"], placed["layers_valid"], hidden, cos, sin)
+                return unembed(cfg, placed, out), kc, vc
+            out, kc, vc, counters = shard_map(
                 stage_prefill, mesh=mesh,
-                in_specs=(lspecs, P("stage"), P(), P(), P()),
-                out_specs=(P(), P("stage"), P("stage")),
+                in_specs=(lspecs, P("stage"), P(), P(), P(), P()),
+                out_specs=(P(), P("stage"), P("stage"), P()),
                 check_vma=False,
-            )(placed["layers"], placed["layers_valid"], hidden, cos, sin)
-            return unembed(cfg, placed, out), kc, vc
+            )(placed["layers"], placed["layers_valid"], hidden, cos, sin,
+              fault_step)
+            return unembed(cfg, placed, out), kc, vc, counters
 
         @jax.jit
         def step_fn(placed, k_cache, v_cache, length, token_ids):
@@ -517,21 +638,31 @@ class SplitRuntime:
             sin_t = jax.lax.dynamic_slice_in_dim(sin, length, 1)
             lspecs = {k: layer_pspec(k, v.ndim)
                       for k, v in placed["layers"].items()}
-            out, kc, vc = shard_map(
+            if link is None:
+                out, kc, vc = shard_map(
+                    stage_step, mesh=mesh,
+                    in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                              P(), P(), P()),
+                    out_specs=(P(), P("stage"), P("stage")),
+                    check_vma=False,
+                )(placed["layers"], placed["layers_valid"], hidden,
+                  k_cache, v_cache, cos_t, sin_t, length)
+                return unembed(cfg, placed, out)[:, -1], kc, vc
+            out, kc, vc, counters = shard_map(
                 stage_step, mesh=mesh,
                 in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
                           P(), P(), P()),
-                out_specs=(P(), P("stage"), P("stage")),
+                out_specs=(P(), P("stage"), P("stage"), P()),
                 check_vma=False,
             )(placed["layers"], placed["layers_valid"], hidden,
               k_cache, v_cache, cos_t, sin_t, length)
-            return unembed(cfg, placed, out)[:, -1], kc, vc
+            return unembed(cfg, placed, out)[:, -1], kc, vc, counters
 
         self._decode_fns_cache[capacity] = (prefill_fn, step_fn)
         return self._decode_fns_cache[capacity]
 
     def prefill_decode(self, placed_params: dict, input_ids: jnp.ndarray,
-                       capacity: int):
+                       capacity: int, fault_step: int = 0):
         """Pipeline-split prefill that also fills the per-stage KV caches.
         Returns (logits (B, S, V) fp32, cache dict) — feed the cache to
         :meth:`decode_step`. Cache k/v: (n_stages, sz, B, capacity, KV, hd),
@@ -542,18 +673,30 @@ class SplitRuntime:
             raise ValueError(
                 f"prompt length {s} must be in [1, capacity={capacity}]")
         prefill_fn, _ = self._decode_fns(int(capacity))
-        logits, kc, vc = prefill_fn(placed_params, input_ids)
+        if self._link is None:
+            logits, kc, vc = prefill_fn(placed_params, input_ids)
+        else:
+            logits, kc, vc, counters = prefill_fn(
+                placed_params, input_ids, jnp.asarray(fault_step, jnp.int32))
+            self._counter_accum.append(counters)
         return logits, {"k": kc, "v": vc, "length": jnp.asarray(s, jnp.int32)}
 
     def decode_step(self, placed_params: dict, cache: dict,
                     token_ids: jnp.ndarray):
         """One decode position across the pipeline: each cut quantizes the
-        single-token hidden state through its wire codec. Returns
+        single-token hidden state through its wire codec (under faults, via
+        the sealed/verified link, keyed by the cache fill level). Returns
         (logits (B, V) fp32, updated cache)."""
         capacity = cache["k"].shape[3]
         _, step_fn = self._decode_fns(int(capacity))
-        logits, kc, vc = step_fn(placed_params, cache["k"], cache["v"],
-                                 cache["length"], token_ids)
+        if self._link is None:
+            logits, kc, vc = step_fn(placed_params, cache["k"], cache["v"],
+                                     cache["length"], token_ids)
+        else:
+            logits, kc, vc, counters = step_fn(
+                placed_params, cache["k"], cache["v"], cache["length"],
+                token_ids)
+            self._counter_accum.append(counters)
         return logits, {"k": kc, "v": vc, "length": cache["length"] + 1}
 
     def decode_hop_bytes(self, batch: int) -> list:

@@ -26,6 +26,7 @@ HF directory), else random init (smoke/benchmark mode).
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -92,6 +93,114 @@ def _load_model(args):
     return cfg, init_params(cfg, jax.random.key(args.seed))
 
 
+#: every key any experiment reads, with the experiments that consume it —
+#: unknown keys fail fast instead of being silently ignored (a typo'd
+#: "hop_codec" used to run the whole eval with defaults)
+_PARAM_KEYS = {
+    "experiment": "all",
+    "max_length": "all", "stride": "all",
+    "methods": "token/channel sweeps",
+    "layers_of_interest": "initial/token/channel sweeps",
+    "ratios": "initial/token sweeps",
+    "cuts": "split", "hop_codecs": "split", "importance_method": "split",
+    "n_seq": "split", "n_data": "split", "n_model": "split",
+    "faults": "split", "link_policy": "split",
+    "max_compiles": "distances",
+}
+_EXPERIMENTS = ("", "initial", "last_row", "relevance", "split", "distances")
+_REQUIRED = {"split": ("cuts", "hop_codecs"),
+             "initial": ("layers_of_interest", "ratios")}
+
+
+def _validate_params_json(p: dict) -> None:
+    """Fail fast — naming the offending key — before any device work starts.
+
+    Checks the key set, per-experiment required keys, basic value shapes, and
+    resolves every codec name (hop codecs, fault-ladder tiers) and fault/policy
+    field against the real constructors, so a typo'd params.json dies in
+    milliseconds instead of after the model loads."""
+    def die(msg):
+        raise SystemExit(f"params.json: {msg}")
+
+    if not isinstance(p, dict):
+        die(f"expected a JSON object, got {type(p).__name__}")
+    unknown = sorted(set(p) - set(_PARAM_KEYS))
+    if unknown:
+        die(f"unknown key(s) {unknown}; known keys: {sorted(_PARAM_KEYS)}")
+    exp = p.get("experiment", "")
+    if exp not in _EXPERIMENTS:
+        die(f"unknown experiment {exp!r}; options: {list(_EXPERIMENTS)}")
+    if exp != "split" and ("faults" in p or "link_policy" in p):
+        die("faults/link_policy only apply to experiment 'split'")
+    for k in _REQUIRED.get(exp, ()):
+        if k not in p:
+            die(f"experiment {exp!r} requires key {k!r}")
+    if exp not in ("split", "initial", "relevance", "distances"):
+        # token/channel sweeps (the default dispatch) sweep layers (x ratios
+        # for the token sweep; the channel sweep has no ratio axis)
+        methods = p.get("methods", [])
+        need = ["layers_of_interest"]
+        if not (methods and isinstance(methods[0], str)
+                and "channel" in methods[0]):
+            need.append("ratios")
+        for k in need:
+            if k not in p:
+                die(f"experiment {exp or '(token sweep)'!r} requires key {k!r}")
+    for k in ("max_length", "stride", "n_seq", "n_data", "n_model",
+              "max_compiles"):
+        if k in p and (not isinstance(p[k], int) or isinstance(p[k], bool)
+                       or p[k] < 1):
+            die(f"{k} must be a positive integer, got {p[k]!r}")
+    for k in ("methods", "layers_of_interest", "ratios", "cuts", "hop_codecs"):
+        if k in p and not isinstance(p[k], list):
+            die(f"{k} must be a list, got {type(p[k]).__name__}")
+    if exp == "split":
+        if not p["cuts"] or not all(
+                isinstance(c, int) and not isinstance(c, bool) and c >= 0
+                for c in p["cuts"]):
+            die(f"cuts must be a non-empty list of layer indices, "
+                f"got {p['cuts']!r}")
+        if len(p["hop_codecs"]) != len(p["cuts"]):
+            die(f"hop_codecs has {len(p['hop_codecs'])} entries for "
+                f"{len(p['cuts'])} cut(s)")
+        from .codecs.packing import get_wire_codec
+        from .eval.split_eval import parse_hop_codec
+
+        for spec in p["hop_codecs"]:
+            if not isinstance(spec, str):
+                die(f"hop_codecs entries must be codec spec strings, "
+                    f"got {spec!r}")
+            try:
+                resolved = parse_hop_codec(spec, p.get("n_seq", 1))
+                if isinstance(resolved, str):
+                    get_wire_codec(resolved)
+            except (ValueError, KeyError) as e:
+                die(f"bad hop codec {spec!r}: {e}")
+        from .codecs.faults import FaultConfig, LinkPolicy
+
+        for key, cls in (("faults", FaultConfig), ("link_policy", LinkPolicy)):
+            if key not in p:
+                continue
+            if not isinstance(p[key], dict):
+                die(f"{key} must be an object of {cls.__name__} fields, "
+                    f"got {p[key]!r}")
+            fields = {f.name for f in dataclasses.fields(cls)}
+            bad = sorted(set(p[key]) - fields)
+            if bad:
+                die(f"{key}: unknown field(s) {bad}; known: {sorted(fields)}")
+            try:
+                obj = cls(**{**p[key], "tiers": tuple(p[key].get("tiers", ()))}
+                          if key == "link_policy" else p[key])
+            except (TypeError, ValueError) as e:
+                die(f"{key}: {e}")
+            if key == "link_policy":
+                for t in obj.tiers:
+                    try:
+                        get_wire_codec(t)
+                    except ValueError as e:
+                        die(f"link_policy.tiers: {e}")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -139,6 +248,7 @@ def main(argv=None) -> int:
     else:
         with open(args.params) as f:
             params_json = json.load(f)
+    _validate_params_json(params_json)
 
     def load_head_weights():
         if not args.head_weights:
@@ -286,7 +396,9 @@ def main(argv=None) -> int:
                 n_seq=params_json.get("n_seq", 1),
                 checkpoint_path=out("split_checkpoint.json"),
                 checkpoint_every=args.checkpoint_every,
-                metrics_path=out("split_metrics.jsonl"))
+                metrics_path=out("split_metrics.jsonl"),
+                faults=params_json.get("faults"),
+                link_policy=params_json.get("link_policy"))
             with open(out("split_eval_results.json"), "w") as f:
                 json.dump(result, f, indent=1)
             print(json.dumps(result))

@@ -1,3 +1,3 @@
-from .decode import generate, decode_step_cache_size
+from .decode import generate, generate_split, decode_step_cache_size
 
-__all__ = ["generate", "decode_step_cache_size"]
+__all__ = ["generate", "generate_split", "decode_step_cache_size"]

@@ -121,3 +121,74 @@ def generate(cfg: ModelConfig, params: dict, prompt_ids, max_new_tokens: int,
             decode_step_cache_misses=decode_step_cache_size() - misses0,
         )
     return out
+
+
+def generate_split(rt, placed_params: dict, prompt_ids, max_new_tokens: int,
+                   *,
+                   capacity: Optional[int] = None,
+                   temperature: float = 0.0,
+                   rng_key: Optional[jax.Array] = None,
+                   fault_step: int = 0,
+                   stats: Optional[dict] = None) -> jnp.ndarray:
+    """``generate`` over the pipeline-SPLIT decode runtime: one split prefill,
+    then O(1) :meth:`SplitRuntime.decode_step` calls, every emitted token
+    crossing each cut as a packed wire payload — and, when the runtime was
+    built with faults, a sealed/verified/retried one (each step's fault stream
+    is keyed by the cache fill level, so generation is seed-reproducible).
+
+    ``rt`` is a :class:`~edgellm_tpu.parallel.split.SplitRuntime`;
+    ``placed_params`` comes from ``rt.place_params``. ``fault_step`` seeds the
+    prefill's fault stream (vary it across prompts to decorrelate them).
+    ``stats`` gains the same timing fields as ``generate`` plus, under faults,
+    ``link_counters`` — the per-hop detected/retried/recovered/substituted
+    totals incurred by THIS call.
+    """
+    prompt_ids = jnp.asarray(prompt_ids)
+    if prompt_ids.ndim != 2:
+        raise ValueError(f"prompt_ids must be (B, S), got {prompt_ids.shape}")
+    b, s = prompt_ids.shape
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+    capacity = s + max_new_tokens if capacity is None else int(capacity)
+    if s + max_new_tokens > capacity:
+        raise ValueError(
+            f"cache capacity overflow: prompt {s} + {max_new_tokens} new "
+            f"tokens > capacity {capacity}")
+    temperature = float(temperature)
+    if temperature < 0.0:
+        raise ValueError("temperature must be >= 0")
+    key = jax.random.key(0) if rng_key is None else rng_key
+    counters0 = rt.link_counters() if hasattr(rt, "link_counters") else None
+
+    t0 = time.monotonic()
+    logits, cache = rt.prefill_decode(placed_params, prompt_ids, capacity,
+                                      fault_step=fault_step)
+    tok = _sample(logits[:, -1], jax.random.fold_in(key, 0), temperature)
+    jax.block_until_ready(tok)
+    t1 = time.monotonic()
+
+    toks = [tok]
+    for t in range(1, max_new_tokens):
+        step_logits, cache = rt.decode_step(placed_params, cache, tok)
+        tok = _sample(step_logits, jax.random.fold_in(key, t), temperature)
+        toks.append(tok)
+    out = jnp.stack(toks, axis=1)  # (B, max_new_tokens)
+    jax.block_until_ready(out)
+    t2 = time.monotonic()
+
+    if stats is not None:
+        steps = max_new_tokens - 1
+        stats.update(
+            capacity=capacity,
+            prefill_s=t1 - t0,
+            decode_s=t2 - t1,
+            decode_steps=steps,
+            decode_tokens_per_s=(b * steps / (t2 - t1)) if steps else 0.0,
+        )
+        counters1 = rt.link_counters() if hasattr(rt, "link_counters") else None
+        if counters1 is not None:
+            stats["link_counters"] = {
+                k: [int(x) for x in (v if counters0 is None
+                                     else v - counters0[k])]
+                for k, v in counters1.items()}
+    return out

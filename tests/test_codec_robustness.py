@@ -1,0 +1,86 @@
+"""Pathological-input robustness for every wire codec: NaN / Inf / huge
+activations must round-trip to FINITE, DETERMINISTIC output (or raise) —
+never silent garbage on the wire. The sanitize contract: non-finite values
+become 0, magnitudes saturate at min(SATURATE_MAG, dtype max).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from edgellm_tpu.codecs.packing import (WIRE_CODECS, SATURATE_MAG,
+                                        get_wire_codec, sanitize_hidden,
+                                        selective_int4)
+
+SHAPE = (2, 8, 24)
+
+
+def _pathological(rng):
+    base = rng.normal(size=SHAPE).astype(np.float32)
+    nan = base.copy()
+    nan[0, :, 0] = np.nan
+    inf = base.copy()
+    inf[0, 1, :] = np.inf
+    inf[1, 2, :] = -np.inf
+    huge = np.where(base > 0, 3e38, -3e38).astype(np.float32)
+    mixed = base.copy()
+    mixed[0, 0, 0] = np.nan
+    mixed[0, 0, 1] = np.inf
+    mixed[1, -1, -1] = -np.inf
+    mixed[1, 0, 0] = 3e38
+    return {"all_nan": np.full(SHAPE, np.nan, np.float32), "some_nan": nan,
+            "inf_rows": inf, "huge": huge, "mixed": mixed,
+            "zeros": np.zeros(SHAPE, np.float32)}
+
+
+@pytest.mark.parametrize("name", WIRE_CODECS)
+def test_pathological_roundtrip_finite_and_deterministic(name, rng):
+    codec = get_wire_codec(name)
+    for case, arr in _pathological(rng).items():
+        h = jnp.asarray(arr)
+        out1 = np.asarray(codec.decode(codec.encode(h)))
+        out2 = np.asarray(codec.decode(codec.encode(h)))
+        assert out1.shape == SHAPE, f"{name}/{case}"
+        assert np.isfinite(out1).all(), \
+            f"{name}/{case}: non-finite values crossed the wire"
+        np.testing.assert_array_equal(out1, out2,
+                                      err_msg=f"{name}/{case} nondeterministic")
+
+
+@pytest.mark.parametrize("ratio,high", [(0.5, "bf16"), (0.25, "fp16")])
+def test_selective_codec_pathological(ratio, high, rng):
+    codec = selective_int4(ratio, high)
+    imp = jnp.asarray(rng.uniform(size=SHAPE[:2]).astype(np.float32))
+    for case, arr in _pathological(rng).items():
+        h = jnp.asarray(arr)
+        out1 = np.asarray(codec.decode(codec.encode(h, imp)))
+        out2 = np.asarray(codec.decode(codec.encode(h, imp)))
+        assert np.isfinite(out1).all(), f"{ratio}/{high}/{case}"
+        np.testing.assert_array_equal(out1, out2)
+
+
+def test_sanitize_hidden_contract():
+    h = jnp.asarray([np.nan, np.inf, -np.inf, 2e38, -2e38, 1.5, 0.0],
+                    jnp.float32)
+    out = np.asarray(sanitize_hidden(h))
+    np.testing.assert_array_equal(
+        out, np.asarray([0.0, SATURATE_MAG, -SATURATE_MAG, SATURATE_MAG,
+                         -SATURATE_MAG, 1.5, 0.0], np.float32))
+
+
+def test_fp16_codec_saturates_to_dtype_max():
+    codec = get_wire_codec("fp16")
+    h = jnp.full(SHAPE, 1e30, jnp.float32)
+    out = np.asarray(codec.decode(codec.encode(h)))
+    assert np.isfinite(out).all()
+    assert np.all(out == np.float32(np.finfo(np.float16).max))
+
+
+def test_huge_but_finite_scales_do_not_poison_quantized_codecs(rng):
+    """A single huge outlier must not turn the rest of the row into NaN."""
+    arr = rng.normal(size=SHAPE).astype(np.float32)
+    arr[0, 0, 0] = 1e38
+    for name in ("int8_per_token", "int4_per_token", "ternary_per_token"):
+        out = np.asarray(get_wire_codec(name).decode(
+            get_wire_codec(name).encode(jnp.asarray(arr))))
+        assert np.isfinite(out).all(), name

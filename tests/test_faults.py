@@ -1,0 +1,398 @@
+"""Faulty-link resilience: integrity detection, seeded injection, retry and
+degradation policies, and the bit-exactness of the fault-disabled path.
+
+The load-bearing claims, each asserted here:
+- the canary + weighted-byte checksum detects EVERY single corrupted byte
+  (odd weights are invertible mod 2**32) and every injected corruption the
+  fault layer can produce — verification outcome == payload-unchanged, always;
+- with a zero-fault active link the runtimes produce bit-identical logits to
+  the plain build, and a disabled FaultConfig builds the plain graph itself;
+- same seed => identical fault sequence => identical logits AND counters;
+- retries genuinely recover, exhausted retries substitute (finite output),
+  the byte budget statically squeezes oversized hops, and the host-side tier
+  controller walks the codec ladder with hysteresis.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from edgellm_tpu.codecs.faults import (FaultConfig, LinkPolicy,
+                                       TierController, inject_faults,
+                                       payload_checksum, seal_payload,
+                                       tree_nbytes, verify_payload)
+from edgellm_tpu.models import init_params, tiny_config
+from edgellm_tpu.parallel import SplitConfig, SplitRuntime, make_stage_mesh
+
+CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4,
+                  vocab_size=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(1))
+
+
+@pytest.fixture(scope="module")
+def ids():
+    rng = np.random.default_rng(5)
+    return jnp.asarray(rng.integers(0, CFG.vocab_size, (1, 24)))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_stage_mesh(2)
+
+
+SPLIT = SplitConfig(cuts=(2,), hop_codecs=("int8_per_token",))
+
+
+def _counters(rt):
+    return {k: v.tolist() for k, v in rt.link_counters().items()}
+
+
+# ---------- integrity layer (no mesh) ----------
+
+
+def _tiny_payload():
+    return {"packed": jnp.arange(6, dtype=jnp.int8).reshape(2, 3),
+            "scale": jnp.asarray([1.5, -2.25], jnp.float32)}
+
+
+def test_checksum_detects_every_single_byte_flip():
+    sealed = seal_payload(_tiny_payload())
+    assert bool(verify_payload(sealed))
+    for leaf_name in ("packed", "scale"):
+        raw = bytearray(np.asarray(sealed["p"][leaf_name]).tobytes())
+        template = np.asarray(sealed["p"][leaf_name])
+        for pos in range(len(raw)):
+            for bit in (0, 3, 7):
+                mutated = bytearray(raw)
+                mutated[pos] ^= 1 << bit
+                leaf = np.frombuffer(bytes(mutated), template.dtype).reshape(
+                    template.shape)
+                corrupt = dict(sealed, p=dict(sealed["p"],
+                                              **{leaf_name: jnp.asarray(leaf)}))
+                assert not bool(verify_payload(corrupt)), \
+                    f"byte {pos} bit {bit} of {leaf_name} slipped through"
+
+
+def test_canary_dies_on_drop():
+    sealed = jax.tree.map(jnp.zeros_like, seal_payload(_tiny_payload()))
+    assert not bool(verify_payload(sealed))
+
+
+def test_verification_outcome_equals_payload_unchanged():
+    """100% detection: over many injection draws, the integrity check passes
+    IFF the injector left every payload byte untouched."""
+    cfg = FaultConfig(bitflip_rate=0.02, scale_corrupt_rate=0.05,
+                      drop_rate=0.15)
+    sealed = seal_payload(_tiny_payload())
+    flat0 = [np.asarray(x) for x in jax.tree.leaves(sealed)]
+    hits = 0
+    for i in range(64):
+        injected = inject_faults(sealed, jax.random.key(i), cfg)
+        ok = bool(verify_payload(injected))
+        # a corrupted sidecar (canary/crc byte) is a detected corruption too,
+        # so "unchanged" is judged over the entire sealed tree
+        unchanged = all(np.array_equal(a, np.asarray(b)) for a, b in
+                        zip(flat0, jax.tree.leaves(injected)))
+        assert ok == unchanged, f"draw {i}: verify={ok} unchanged={unchanged}"
+        hits += not unchanged
+    assert hits > 10  # the rates above must actually exercise detection
+
+
+def test_injection_is_seed_deterministic():
+    cfg = FaultConfig(bitflip_rate=0.05, drop_rate=0.2)
+    sealed = seal_payload(_tiny_payload())
+    a = inject_faults(sealed, jax.random.key(7), cfg)
+    b = inject_faults(sealed, jax.random.key(7), cfg)
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_checksum_covers_leaf_order():
+    """Identical bytes in different leaves hash differently (per-leaf salt)."""
+    a = payload_checksum({"x": jnp.ones((4,), jnp.int8),
+                          "y": jnp.zeros((4,), jnp.int8)})
+    b = payload_checksum({"x": jnp.zeros((4,), jnp.int8),
+                          "y": jnp.ones((4,), jnp.int8)})
+    assert int(a) != int(b)
+
+
+def test_tree_nbytes():
+    assert tree_nbytes(_tiny_payload()) == 6 + 8
+
+
+def test_fault_config_validation():
+    with pytest.raises(ValueError):
+        FaultConfig(drop_rate=1.5)
+    with pytest.raises(ValueError):
+        FaultConfig(byte_budget=0)
+    with pytest.raises(ValueError):
+        LinkPolicy(on_fail="explode")
+    with pytest.raises(ValueError):
+        LinkPolicy(max_retries=-1)
+    assert not FaultConfig().enabled
+    assert FaultConfig(byte_budget=1).enabled
+
+
+def test_tier_controller_hysteresis():
+    tc = TierController(3, degrade_after=2, recover_after=3)
+    assert [tc.observe(c) for c in (True,)] == [0]  # 1 bad < degrade_after
+    assert tc.observe(True) == 1      # 2 consecutive bad -> down
+    assert tc.observe(True) == 1      # streak reset on switch
+    assert tc.observe(True) == 2      # and again
+    assert tc.observe(True) == 2      # floor
+    assert [tc.observe(False) for _ in range(2)] == [2, 2]
+    assert tc.observe(False) == 1     # 3 consecutive clean -> up
+    assert tc.observe(True) == 1      # clean streak broken
+    assert [tc.observe(False) for _ in range(3)] == [1, 1, 0]
+    assert tc.switches == 4
+
+
+# ---------- split runtime under faults ----------
+
+
+def test_zero_fault_active_link_bit_exact(params, ids, mesh):
+    """The whole sealed/verified/retry machinery at zero fault rate changes
+    NOTHING: logits bit-identical to the plain runtime."""
+    base = SplitRuntime(CFG, SPLIT, mesh)
+    out0 = base.forward(base.place_params(params), ids)
+    rt = SplitRuntime(CFG, SPLIT, mesh, faults=FaultConfig(byte_budget=10**9),
+                      policy=LinkPolicy(max_retries=1))
+    out1 = rt.forward(rt.place_params(params), ids, fault_step=3)
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(out1))
+    c = _counters(rt)
+    assert c["hops"] == [1] and c["detected"] == [0]
+    assert c["substituted"] == [0] and c["budget_dropped"] == [0]
+
+
+def test_disabled_config_builds_plain_graph(params, ids, mesh):
+    rt = SplitRuntime(CFG, SPLIT, mesh, faults=FaultConfig())
+    assert rt._link is None and rt.link_counters() is None
+    base = SplitRuntime(CFG, SPLIT, mesh)
+    np.testing.assert_array_equal(
+        np.asarray(base.forward(base.place_params(params), ids)),
+        np.asarray(rt.forward(rt.place_params(params), ids)))
+
+
+def test_retry_recovers_and_counters_are_consistent(params, ids, mesh):
+    rt = SplitRuntime(CFG, SPLIT, mesh,
+                      faults=FaultConfig(drop_rate=0.5, seed=7),
+                      policy=LinkPolicy(max_retries=4))
+    placed = rt.place_params(params)
+    for step in range(8):
+        out = rt.forward(placed, ids, fault_step=step)
+    assert np.isfinite(np.asarray(out)).all()
+    c = _counters(rt)
+    assert c["hops"] == [8]
+    assert c["detected"][0] > 0 and c["recovered"][0] > 0
+    # "detected" counts every failed attempt (retries included); each hop whose
+    # first attempt failed ends as exactly one of recovered / substituted
+    assert c["detected"][0] >= c["recovered"][0] + c["substituted"][0]
+    assert c["recovered"][0] + c["substituted"][0] > 0
+    assert c["retried"][0] >= c["recovered"][0]
+
+
+def test_same_seed_same_faults_same_logits(params, ids, mesh):
+    outs, counters = [], []
+    for _ in range(2):
+        rt = SplitRuntime(CFG, SPLIT, mesh,
+                          faults=FaultConfig(drop_rate=0.5, seed=7),
+                          policy=LinkPolicy(max_retries=4))
+        placed = rt.place_params(params)
+        acc = []
+        for step in range(6):
+            acc.append(np.asarray(rt.forward(placed, ids, fault_step=step)))
+        outs.append(np.stack(acc))
+        counters.append(_counters(rt))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    assert counters[0] == counters[1]
+
+
+def test_different_seed_different_faults(params, ids, mesh):
+    got = []
+    for seed in (1, 2):
+        rt = SplitRuntime(CFG, SPLIT, mesh,
+                          faults=FaultConfig(drop_rate=0.5, seed=seed))
+        placed = rt.place_params(params)
+        for step in range(6):
+            rt.forward(placed, ids, fault_step=step)
+        got.append(_counters(rt)["detected"][0])
+    assert got[0] != got[1] or True  # drop sequences may coincide in count...
+    # ...so assert on the full per-step stream instead
+    streams = []
+    for seed in (1, 2):
+        rt = SplitRuntime(CFG, SPLIT, mesh,
+                          faults=FaultConfig(drop_rate=0.5, seed=seed))
+        placed = rt.place_params(params)
+        stream = []
+        for step in range(8):
+            rt.forward(placed, ids, fault_step=step)
+            stream.append(_counters(rt)["detected"][0])
+        streams.append(stream)
+    assert streams[0] != streams[1]
+
+
+def test_total_loss_substitutes_finite_state(params, ids, mesh):
+    rt = SplitRuntime(CFG, SPLIT, mesh, faults=FaultConfig(drop_rate=1.0))
+    out = rt.forward(rt.place_params(params), ids)
+    assert np.isfinite(np.asarray(out)).all()
+    c = _counters(rt)
+    assert c["detected"] == [1] and c["substituted"] == [1]
+    assert c["recovered"] == [0]
+
+
+def test_passthrough_policy_counts_but_decodes(params, ids, mesh):
+    rt = SplitRuntime(CFG, SPLIT, mesh,
+                      faults=FaultConfig(bitflip_rate=0.05, seed=2),
+                      policy=LinkPolicy(on_fail="passthrough"))
+    placed = rt.place_params(params)
+    for step in range(4):
+        out = rt.forward(placed, ids, fault_step=step)
+    c = _counters(rt)
+    assert c["detected"][0] > 0 and c["substituted"][0] > 0
+    # passthrough accepts the corrupted decode (a flipped scale byte may even
+    # be non-finite) — the contract is detection/counting, not clean output
+    assert np.asarray(out).shape == (1, ids.shape[1], CFG.vocab_size)
+
+
+def test_byte_budget_squeezes_hop(params, ids, mesh):
+    rt = SplitRuntime(CFG, SPLIT, mesh, faults=FaultConfig(byte_budget=8))
+    out = rt.forward(rt.place_params(params), ids)
+    assert np.isfinite(np.asarray(out)).all()
+    c = _counters(rt)
+    assert c["budget_dropped"] == [1] and c["substituted"] == [1]
+
+
+def test_faulty_decode_runs_and_zero_fault_decode_is_exact(params, ids, mesh):
+    base = SplitRuntime(CFG, SPLIT, mesh)
+    pb = base.place_params(params)
+    logits0, cache0 = base.prefill_decode(pb, ids, capacity=32)
+    tok = jnp.argmax(logits0[:, -1], -1).astype(jnp.int32)
+    steps0 = []
+    for _ in range(4):
+        s, cache0 = base.decode_step(pb, cache0, tok)
+        steps0.append(np.asarray(s))
+
+    rt = SplitRuntime(CFG, SPLIT, mesh, faults=FaultConfig(byte_budget=10**9),
+                      policy=LinkPolicy(max_retries=2))
+    pz = rt.place_params(params)
+    logits1, cache1 = rt.prefill_decode(pz, ids, capacity=32)
+    np.testing.assert_array_equal(np.asarray(logits0), np.asarray(logits1))
+    for i in range(4):
+        s, cache1 = rt.decode_step(pz, cache1, tok)
+        np.testing.assert_array_equal(steps0[i], np.asarray(s))
+    assert _counters(rt)["detected"] == [0]
+
+    rt_f = SplitRuntime(CFG, SPLIT, mesh,
+                        faults=FaultConfig(drop_rate=0.5, seed=7),
+                        policy=LinkPolicy(max_retries=4))
+    pf = rt_f.place_params(params)
+    logits, cache = rt_f.prefill_decode(pf, ids, capacity=32)
+    for _ in range(4):
+        logits, cache = rt_f.decode_step(pf, cache, tok)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert _counters(rt_f)["hops"] == [5]  # prefill + 4 steps
+
+
+def test_generate_split_zero_fault_bit_exact(params, ids, mesh):
+    from edgellm_tpu.serve import generate_split
+
+    base = SplitRuntime(CFG, SPLIT, mesh)
+    out0 = generate_split(base, base.place_params(params), ids, 6)
+    rt = SplitRuntime(CFG, SPLIT, mesh, faults=FaultConfig(byte_budget=10**9))
+    st: dict = {}
+    out1 = generate_split(rt, rt.place_params(params), ids, 6, stats=st)
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(out1))
+    assert sum(st["link_counters"]["detected"]) == 0
+    assert sum(st["link_counters"]["hops"]) == 6
+
+
+# ---------- ring runtime under faults ----------
+
+
+def test_ring_zero_fault_bit_exact_and_faulty_counters(params):
+    from edgellm_tpu.parallel.ring import SplitRingRuntime, make_sp_stage_mesh
+
+    mesh = make_sp_stage_mesh(2, 2)
+    rng = np.random.default_rng(3)
+    rids = jnp.asarray(rng.integers(0, CFG.vocab_size, (1, 16)))
+    base = SplitRingRuntime(CFG, (2,), ["int8_per_token"], mesh)
+    out0 = base.forward(base.place_params(params), rids)
+
+    rt = SplitRingRuntime(CFG, (2,), ["int8_per_token"], mesh,
+                          faults=FaultConfig(byte_budget=10**9),
+                          policy=LinkPolicy(max_retries=1))
+    out1 = rt.forward(rt.place_params(params), rids, fault_step=2)
+    np.testing.assert_array_equal(np.asarray(out0), np.asarray(out1))
+    c = _counters(rt)
+    assert c["hops"] == [2] and c["detected"] == [0]  # 1 hop x 2 seq shards
+
+    rt_f = SplitRingRuntime(CFG, (2,), ["int8_per_token"], mesh,
+                            faults=FaultConfig(drop_rate=0.5, seed=11),
+                            policy=LinkPolicy(max_retries=3))
+    pf = rt_f.place_params(params)
+    for step in range(6):
+        out = rt_f.forward(pf, rids, fault_step=step)
+    assert np.isfinite(np.asarray(out)).all()
+    cf = _counters(rt_f)
+    assert cf["hops"] == [12] and cf["detected"][0] > 0
+    assert cf["detected"][0] >= cf["recovered"][0] + cf["substituted"][0]
+    assert cf["recovered"][0] + cf["substituted"][0] > 0
+
+
+# ---------- eval integration ----------
+
+
+def test_split_eval_faulty_reproducible_and_adaptive(params):
+    from edgellm_tpu.eval.split_eval import run_split_eval
+
+    toks = np.random.default_rng(0).integers(0, CFG.vocab_size, (1024,))
+    kw = dict(cuts=(2,), hop_codecs=["int8_per_token"], max_length=64,
+              stride=32, time_hops=False)
+
+    base = run_split_eval(CFG, params, toks, **kw)
+    act = run_split_eval(CFG, params, toks, faults={"byte_budget": 10**9},
+                         **kw)
+    assert act["ppl"] == base["ppl"]  # zero-fault active link: exact
+    assert act["link_counters"]["detected"] == [0]
+
+    runs = [run_split_eval(CFG, params, toks,
+                           faults={"drop_rate": 0.4, "seed": 3},
+                           link_policy={"max_retries": 2}, **kw)
+            for _ in range(2)]
+    assert runs[0]["ppl"] == runs[1]["ppl"]
+    assert runs[0]["link_counters"] == runs[1]["link_counters"]
+    assert runs[0]["link_counters"]["detected"][0] > 0
+
+    ad = run_split_eval(CFG, params, toks,
+                        faults={"bitflip_rate": 0.01, "seed": 1},
+                        link_policy={"max_retries": 0,
+                                     "tiers": ["int4_per_token",
+                                               "ternary_per_token"],
+                                     "degrade_after": 1, "recover_after": 50},
+                        **kw)
+    assert ad["final_tier"] > 0 and ad["degraded_chunks"] > 0
+    assert ad["tier_ladder"][-1] == ["ternary_per_token"]
+    assert ad["tier_switches"]  # (chunk, tier) trail is recorded
+    assert np.isfinite(ad["ppl"])
+
+
+def test_run_fault_sweep_rate_zero_is_exact_baseline(params):
+    from edgellm_tpu.eval.split_eval import run_fault_sweep, run_split_eval
+
+    toks = np.random.default_rng(0).integers(0, CFG.vocab_size, (512,))
+    kw = dict(cuts=(2,), hop_codecs=["int8_per_token"], max_length=64,
+              stride=32, time_hops=False)
+    base = run_split_eval(CFG, params, toks, **kw)
+    sweep = run_fault_sweep(CFG, params, toks, rates=[0.0, 0.5],
+                            knob="drop_rate", **kw)
+    assert sweep[0]["ppl"] == base["ppl"]
+    assert "link_counters" not in sweep[0]
+    assert sweep[1]["fault_rate"] == 0.5
+    assert sweep[1]["link_counters"]["detected"][0] > 0
+    with pytest.raises(ValueError):
+        run_fault_sweep(CFG, params, toks, rates=[0.1], knob="gamma_rays",
+                        **kw)

@@ -1,0 +1,170 @@
+"""Satellite robustness: params.json schema validation (fail fast, name the
+bad key), safetensors integrity verification (corrupt files rejected before
+any tensor is materialized), and bounded-retry fetches (exponential backoff,
+atomic dest write, actionable terminal errors).
+"""
+import json
+import struct
+import urllib.error
+
+import numpy as np
+import pytest
+
+from edgellm_tpu.models.hf_loader import fetch_with_retry
+from edgellm_tpu.models.safetensors_io import (read_safetensors,
+                                               verify_safetensors_integrity)
+from edgellm_tpu.run import _validate_params_json
+from tests.test_safetensors_io import write_safetensors
+
+# ---------- params.json schema validation ----------
+
+SPLIT_OK = {"experiment": "split", "max_length": 64, "stride": 32,
+            "cuts": [2], "hop_codecs": ["int8_per_token"]}
+
+
+def test_all_shipped_configs_validate():
+    import glob
+    import os
+    cfg_dir = os.path.join(os.path.dirname(__file__), "..", "configs")
+    paths = sorted(glob.glob(os.path.join(cfg_dir, "*.json")))
+    assert paths
+    for p in paths:
+        with open(p) as f:
+            _validate_params_json(json.load(f))  # must not raise
+
+
+def test_valid_split_params_pass():
+    _validate_params_json(dict(SPLIT_OK))
+    _validate_params_json(dict(SPLIT_OK, faults={"drop_rate": 0.1},
+                               link_policy={"max_retries": 1,
+                                            "tiers": ["int4_per_token"]}))
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda p: p.update(hop_codex=["int8_per_token"]), "hop_codex"),
+    (lambda p: p.update(experiment="tachyon"), "tachyon"),
+    (lambda p: p.pop("cuts"), "cuts"),
+    (lambda p: p.update(hop_codecs=["int8_per_token", "fp32"]), "hop_codecs"),
+    (lambda p: p.update(hop_codecs=["warp_drive"]), "warp_drive"),
+    (lambda p: p.update(faults={"drop_rat": 0.1}), "drop_rat"),
+    (lambda p: p.update(faults={"drop_rate": 2.0}), "drop_rate"),
+    (lambda p: p.update(link_policy={"tiers": ["unobtainium"]}),
+     "unobtainium"),
+    (lambda p: p.update(link_policy={"max_retries": "two"}), "max_retries"),
+    (lambda p: p.update(max_length=-5), "max_length"),
+    (lambda p: p.update(cuts="2"), "cuts"),
+])
+def test_bad_split_params_die_naming_the_problem(mutate, needle):
+    p = {k: (list(v) if isinstance(v, list) else v)
+         for k, v in SPLIT_OK.items()}
+    mutate(p)
+    with pytest.raises(SystemExit, match=needle):
+        _validate_params_json(p)
+
+
+def test_faults_outside_split_experiment_die():
+    with pytest.raises(SystemExit, match="split"):
+        _validate_params_json({"experiment": "last_row", "max_length": 64,
+                               "stride": 32, "faults": {"drop_rate": 0.1}})
+
+
+# ---------- safetensors integrity ----------
+
+
+@pytest.fixture
+def good_st(tmp_path):
+    path = str(tmp_path / "m.safetensors")
+    write_safetensors(path, {
+        "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b": np.arange(5, dtype=np.int32)})
+    return path
+
+
+def test_verify_good_file(good_st):
+    info = verify_safetensors_integrity(good_st)
+    assert info["tensors"] == 2
+    assert info["data_bytes"] == 12 * 4 + 5 * 4
+
+
+def test_truncated_data_rejected(good_st, tmp_path):
+    raw = open(good_st, "rb").read()
+    bad = str(tmp_path / "trunc.safetensors")
+    open(bad, "wb").write(raw[:-7])
+    with pytest.raises(ValueError, match="trunc.safetensors"):
+        verify_safetensors_integrity(bad)
+    with pytest.raises(ValueError):
+        read_safetensors(bad)  # the reader verifies before loading
+
+
+def test_lying_header_len_rejected(good_st, tmp_path):
+    raw = bytearray(open(good_st, "rb").read())
+    raw[:8] = struct.pack("<Q", len(raw) * 4)
+    bad = str(tmp_path / "lying.safetensors")
+    open(bad, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="header"):
+        verify_safetensors_integrity(bad)
+
+
+def test_shape_span_mismatch_rejected(tmp_path):
+    hdr = {"a": {"dtype": "F32", "shape": [3, 4], "data_offsets": [0, 40]}}
+    blob = json.dumps(hdr).encode()
+    bad = str(tmp_path / "span.safetensors")
+    with open(bad, "wb") as f:
+        f.write(struct.pack("<Q", len(blob)) + blob + b"\0" * 40)
+    with pytest.raises(ValueError, match="'a'"):
+        verify_safetensors_integrity(bad)
+
+
+def test_overlapping_tensors_rejected(tmp_path):
+    hdr = {"a": {"dtype": "F32", "shape": [4], "data_offsets": [0, 16]},
+           "b": {"dtype": "F32", "shape": [4], "data_offsets": [8, 24]}}
+    blob = json.dumps(hdr).encode()
+    bad = str(tmp_path / "overlap.safetensors")
+    with open(bad, "wb") as f:
+        f.write(struct.pack("<Q", len(blob)) + blob + b"\0" * 24)
+    with pytest.raises(ValueError, match="overlap"):
+        verify_safetensors_integrity(bad)
+
+
+def test_garbage_json_header_rejected(tmp_path):
+    bad = str(tmp_path / "garbage.safetensors")
+    with open(bad, "wb") as f:
+        f.write(struct.pack("<Q", 4) + b"{!!}")
+    with pytest.raises(ValueError, match="garbage.safetensors"):
+        verify_safetensors_integrity(bad)
+
+
+# ---------- bounded-retry fetch ----------
+
+
+def test_fetch_file_url_roundtrip(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"payload-bytes" * 100)
+    dest = str(tmp_path / "dest.bin")
+    fetch_with_retry("file://" + str(src), dest)
+    assert open(dest, "rb").read() == src.read_bytes()
+
+
+def test_fetch_retries_with_backoff_then_fails(tmp_path):
+    sleeps = []
+    dest = str(tmp_path / "never.bin")
+    with pytest.raises(RuntimeError, match="4 attempts"):
+        fetch_with_retry("file://" + str(tmp_path / "missing.bin"), dest,
+                         max_retries=3, backoff=0.5, _sleep=sleeps.append)
+    assert sleeps == [0.5, 1.0, 2.0]  # exponential, no sleep after last try
+    import os
+    assert not os.path.exists(dest)  # no partial file left behind
+    assert not os.path.exists(dest + ".part")
+
+
+def test_fetch_client_error_fails_immediately(tmp_path, monkeypatch):
+    def boom(url, timeout):
+        raise urllib.error.HTTPError(url, 404, "not found", None, None)
+
+    # fetch_with_retry imports urllib lazily, so patch the stdlib module
+    monkeypatch.setattr("urllib.request.urlopen", boom)
+    sleeps = []
+    with pytest.raises(RuntimeError, match="404"):
+        fetch_with_retry("https://example.invalid/x", str(tmp_path / "x"),
+                         _sleep=sleeps.append)
+    assert sleeps == []  # a 4xx is permanent: no retries
