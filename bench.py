@@ -47,6 +47,13 @@ are visible. Knobs: BENCH_FAULT_RATES (comma floats, default "0,0.05,0.2"),
 BENCH_FAULT_KNOB (drop_rate|bitflip_rate|scale_corrupt_rate),
 BENCH_FAULT_RETRIES, BENCH_FAULT_CODEC, BENCH_FAULT_CHUNKS, BENCH_FAULT_SEED.
 
+BENCH_RECOVERY=1 switches to the survivable-decode workload (see
+``recovery_main``): clean split decode tokens/s, checkpoint-and-resume
+latency (with the DecodeCheckpoint size), and end-to-end throughput across
+an injected stage loss with boundary re-planning failover. Knobs:
+BENCH_RECOVERY_PROMPT, BENCH_RECOVERY_TOKENS, BENCH_RECOVERY_BATCH,
+BENCH_RECOVERY_CODEC.
+
 An over-large BENCH_WINDOW_BATCH never kills the bench: on TPU an AOT
 memory-analysis preflight (tools/wb_preflight.py) halves it to the largest
 batch whose estimated peak fits BEFORE anything runs (a real TPU OOM would
@@ -288,7 +295,173 @@ def faults_main():
     _emit(line, detail)
 
 
+def recovery_main():
+    """BENCH_RECOVERY=1: survivable split decode — checkpoint/resume latency
+    and stage-failover throughput vs the clean split.
+
+    Three legs over ``serve.generate_split``: (1) the clean 2-stage split
+    decode (the baseline tokens/s); (2) halt-at-mid-decode with a
+    :class:`DecodeCheckpoint` write, then a timed :func:`resume_split` of the
+    tail (resume latency + checkpoint size); (3) a stage loss injected at
+    mid-decode with failover re-planning onto the survivors (3 stages when
+    >= 3 devices are visible, else 2 -> single-device fallback) — the
+    headline is the failover run's end-to-end tokens/s, with the clean
+    end-to-end rate and their ratio alongside. Knobs: BENCH_RECOVERY_PROMPT
+    (default 64), BENCH_RECOVERY_TOKENS (default 64), BENCH_RECOVERY_BATCH
+    (default 4), BENCH_RECOVERY_CODEC (default int8_per_token), plus the
+    shared BENCH_MODEL / BENCH_DTYPE. With < 2 devices the split legs are
+    skipped and the checkpoint/resume leg runs on the single-device loop."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.serve import RecoveryConfig, StageFailure
+    from edgellm_tpu.serve.decode import generate, generate_split, resume_split
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    prompt = int(os.environ.get("BENCH_RECOVERY_PROMPT", "64"))
+    new_tokens = int(os.environ.get("BENCH_RECOVERY_TOKENS", "64"))
+    batch = int(os.environ.get("BENCH_RECOVERY_BATCH", "4"))
+    codec = os.environ.get("BENCH_RECOVERY_CODEC", "int8_per_token")
+    capacity = prompt + new_tokens
+    halt = new_tokens // 2
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt)))
+    n_dev = len(jax.devices())
+    ckpt = os.path.join(tempfile.mkdtemp(prefix="bench_recovery_"), "gen.ckpt")
+    detail = {"recovery": {
+        "prompt": prompt, "new_tokens": new_tokens, "batch": batch,
+        "codec": codec, "halt_at_step": halt, "devices": n_dev,
+    }}
+
+    if n_dev < 2:
+        # no split to cut: time checkpoint + resume on the single-device loop
+        generate(cfg, params, ids, new_tokens, capacity=capacity,
+                 compute_dtype=dtype)  # compile
+        st_halt: dict = {}
+        generate(cfg, params, ids, new_tokens, capacity=capacity,
+                 compute_dtype=dtype, stats=st_halt,
+                 recovery=RecoveryConfig(checkpoint_path=ckpt,
+                                         halt_at_step=halt))
+        from edgellm_tpu.serve import LocalRuntime
+
+        rt = LocalRuntime(cfg, dtype)
+        t0 = time.monotonic()
+        st_res: dict = {}
+        resume_split(rt, params, ckpt, stats=st_res)
+        resume_wall = time.monotonic() - t0
+        resumed_steps = new_tokens - 1 - halt
+        tps = batch * resumed_steps / max(resume_wall, 1e-9)
+        detail["recovery"]["resume"] = {
+            "checkpoint_bytes": os.path.getsize(ckpt),
+            "resume_wall_s": round(resume_wall, 4),
+            "resumed_steps": resumed_steps,
+            "counters": st_res.get("recovery_counters"),
+        }
+        _emit({
+            "metric": (f"{model_name} resume decode throughput after a "
+                       f"mid-generation checkpoint (single device; split "
+                       f"legs skipped)"),
+            "value": round(tps, 1),
+            "unit": "resumed decode tokens/s",
+            "vs_baseline": None,  # the reference has no restartable state
+            "resume_wall_s": round(resume_wall, 4),
+            "checkpoint_bytes": os.path.getsize(ckpt),
+        }, detail)
+        return
+
+    from edgellm_tpu.parallel.split import (SplitConfig, SplitRuntime,
+                                            make_stage_mesh)
+
+    cut = min(11, cfg.num_layers // 2)
+    split = SplitConfig(cuts=(cut,), hop_codecs=(codec,))
+    rt = SplitRuntime(cfg, split, make_stage_mesh(2))
+    placed = rt.place_params(params)
+    generate_split(rt, placed, ids, new_tokens, capacity=capacity)  # compile
+    st_clean: dict = {}
+    generate_split(rt, placed, ids, new_tokens, capacity=capacity,
+                   stats=st_clean)
+    clean_tps = st_clean["decode_tokens_per_s"]
+    clean_wall = st_clean["prefill_s"] + st_clean["decode_s"]
+    clean_e2e = batch * new_tokens / max(clean_wall, 1e-9)
+    detail["recovery"]["clean"] = {
+        "cut": cut, "decode_tokens_per_s": round(clean_tps, 2),
+        "end_to_end_tokens_per_s": round(clean_e2e, 2),
+    }
+
+    # leg 2: halt mid-decode with a checkpoint, then time the resumed tail
+    st_halt = {}
+    generate_split(rt, placed, ids, new_tokens, capacity=capacity,
+                   recovery=RecoveryConfig(checkpoint_path=ckpt,
+                                           halt_at_step=halt),
+                   raw_params=params, stats=st_halt)
+    t0 = time.monotonic()
+    st_res = {}
+    resume_split(rt, placed, ckpt, stats=st_res, raw_params=params)
+    resume_wall = time.monotonic() - t0
+    resumed_steps = new_tokens - 1 - halt
+    detail["recovery"]["resume"] = {
+        "checkpoint_bytes": os.path.getsize(ckpt),
+        "resume_wall_s": round(resume_wall, 4),
+        "resumed_steps": resumed_steps,
+        "resumed_tokens_per_s": round(
+            batch * resumed_steps / max(resume_wall, 1e-9), 2),
+        "counters": st_res.get("recovery_counters"),
+    }
+
+    # leg 3: stage loss at mid-decode; failover re-plans onto the survivors
+    # (the wall clock deliberately includes the re-plan, re-place, and
+    # prefix-recompute cost — that IS the failover hit)
+    if n_dev >= 3:
+        cuts3 = tuple(round(i * cfg.num_layers / 3) - 1 for i in (1, 2))
+        frt = SplitRuntime(cfg, SplitConfig(cuts=cuts3,
+                                            hop_codecs=(codec, codec)),
+                           make_stage_mesh(3))
+        lost = 2
+    else:
+        frt = SplitRuntime(cfg, split, make_stage_mesh(2))
+        lost = 1
+    fplaced = frt.place_params(params)
+    st_fail: dict = {}
+    t0 = time.monotonic()
+    generate_split(frt, fplaced, ids, new_tokens, capacity=capacity,
+                   recovery=RecoveryConfig(
+                       stage_failure=StageFailure(stage=lost, at_step=halt)),
+                   raw_params=params, stats=st_fail)
+    fail_wall = time.monotonic() - t0
+    failover_tps = batch * new_tokens / max(fail_wall, 1e-9)
+    detail["recovery"]["failover"] = {
+        "stages": frt.split.n_stages, "lost_stage": lost, "at_step": halt,
+        "end_to_end_tokens_per_s": round(failover_tps, 2),
+        "wall_s": round(fail_wall, 4),
+        "counters": st_fail.get("recovery_counters"),
+    }
+
+    line = {
+        "metric": (f"{model_name} split decode throughput across a stage "
+                   f"loss at step {halt} ({frt.split.n_stages} stages, "
+                   f"{codec})"),
+        "value": round(failover_tps, 1),
+        "unit": "failover tokens/s (end to end)",
+        "vs_baseline": None,  # the reference has no failure model at all
+        "clean_tokens_per_s": round(clean_e2e, 1),
+        "failover_ratio": round(failover_tps / max(clean_e2e, 1e-9), 4),
+        "resume_wall_s": round(resume_wall, 4),
+        "checkpoint_bytes": os.path.getsize(ckpt),
+        "failovers": st_fail.get("recovery_counters", {}).get("failovers"),
+    }
+    _emit(line, detail)
+
+
 def main():
+    if os.environ.get("BENCH_RECOVERY") == "1":
+        return recovery_main()
     if os.environ.get("BENCH_DECODE") == "1":
         return decode_main()
     if os.environ.get("BENCH_FAULTS") == "1":

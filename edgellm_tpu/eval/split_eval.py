@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -33,6 +34,8 @@ from ..importance import importance_per_layer
 from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
 from ..codecs.packing import WireCodec, get_wire_codec, selective_int4
 from ..codecs.faults import FaultConfig, LinkPolicy, TierController, sum_counters
+from ..serve.recovery import (DecodeTimeout, RecoveryCounters, StageFailure,
+                              StageLostError, Watchdog)
 from .harness import (ResumableDriver, _emit, _iter_window_groups,
                       _run_pipelined, fetch_global)
 
@@ -112,6 +115,10 @@ def run_split_eval(
     metrics_path: Optional[str] = None,
     faults: Optional[object] = None,
     link_policy: Optional[object] = None,
+    deadline_s: Optional[float] = None,
+    stage_failure: Optional[object] = None,
+    recovery: Optional[dict] = None,
+    _clock=time.monotonic,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
 
@@ -150,6 +157,24 @@ def run_split_eval(
     Per-hop counters, the tier trail, and degraded-chunk totals land in the
     result. Robustness state is per-run: a resumed run restarts counters and
     the tier ladder at tier 0 (the checkpointed PPL partial sums stay exact).
+
+    Survivability (PR 3): ``deadline_s`` arms a host-side monotonic
+    :class:`~edgellm_tpu.serve.recovery.Watchdog` that is petted after every
+    drained chunk — a stalled eval writes a best-effort resume checkpoint and
+    raises a typed :class:`DecodeTimeout` instead of hanging (``_clock`` is
+    injectable so tests fire it deterministically). ``stage_failure`` (a
+    :class:`StageFailure` or ``{"stage", "at_step"}`` dict; ``at_step`` is a
+    chunk index here) marks that stage dark; the harness then re-plans the
+    split boundary onto the surviving stages (evenly-spaced cuts, the first
+    hop's codec on every new hop), re-places the weights, rebuilds the tier
+    ladder for the new hop count, and continues the SAME accumulation —
+    partial sums, chunk counters, and the metrics stream carry across the
+    failover. ``recovery`` tunes the failover (``{"replan": bool,
+    "max_failovers": int}``); post-failover byte totals are accounted per
+    plan generation in ``result["recovery"]``. Stage failure needs the plain
+    split runtime (``n_seq == 1``) — the stage x seq ring has no failover.
+    With all three left at their defaults the harness builds the exact
+    pre-recovery graph: the knobs are host-side orchestration only.
     """
     if isinstance(faults, dict):
         faults = FaultConfig(**faults)
@@ -159,6 +184,24 @@ def run_split_eval(
             tiers=tuple(link_policy.get("tiers", ())))
     fault_on = faults is not None and faults.enabled
     policy = link_policy if link_policy is not None else LinkPolicy()
+    if isinstance(stage_failure, dict):
+        stage_failure = StageFailure(**stage_failure)
+    if stage_failure is not None and n_seq > 1:
+        raise ValueError(
+            "stage_failure needs the plain split runtime: the stage x seq "
+            "ring has no failover re-planning (n_seq must be 1)")
+    recovery_on = (deadline_s is not None or stage_failure is not None
+                   or bool(recovery))
+    recovery = dict(recovery or {})
+    unknown = set(recovery) - {"replan", "max_failovers"}
+    if unknown:
+        raise ValueError(f"unknown recovery key(s): {sorted(unknown)}")
+    rec_replan = bool(recovery.get("replan", True))
+    rec_max_failovers = int(recovery.get("max_failovers", 1))
+    if rec_max_failovers < 1:
+        raise ValueError("recovery.max_failovers must be >= 1")
+    rcounters = RecoveryCounters()
+    wd = Watchdog(deadline_s, clock=_clock) if deadline_s is not None else None
     codecs = [parse_hop_codec(c, n_seq) if isinstance(c, str) else c
               for c in hop_codecs]
     split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(codecs))
@@ -237,6 +280,8 @@ def run_split_eval(
         axes["faults"] = dataclasses.asdict(faults)
         axes["link_policy"] = {**dataclasses.asdict(policy),
                                "tiers": list(policy.tiers)}
+    if stage_failure is not None:
+        axes["stage_failure"] = dataclasses.asdict(stage_failure)
     rd = ResumableDriver(checkpoint_path, axes, checkpoint_every)
     total_nll, n_tokens = 0.0, 0.0
     fwd_tokens = 0  # every token pushed through the pipeline (incl. overlap/pad)
@@ -256,8 +301,38 @@ def run_split_eval(
     bytes_cache: dict = {}
     degraded_chunks = 0  # chunks that ran below tier 0
     tier_log: list = []  # (chunk_index, tier) at every controller switch
+    gen = 0  # plan generation: bumped on every failover re-plan
+    # gen 0 shares the checkpointed hop_bytes_total list; post-failover plans
+    # have a different hop count, so their bytes accumulate per generation
+    gen_bytes = {0: hop_bytes_total}
+    sf_pending = stage_failure is not None
+
+    def _eval_failover(lost: int):
+        """Re-plan the boundary onto the survivors and swap every per-tier
+        runtime; the accumulated partial sums carry over untouched (the PPL
+        metric does not depend on where the boundary sits)."""
+        nonlocal mesh, split, placed, gen, ladder
+        if not rec_replan or rcounters.failovers >= rec_max_failovers:
+            raise  # the active StageLostError stays fatal
+        rcounters.failovers += 1
+        from jax.sharding import Mesh
+
+        survivors = np.delete(np.asarray(mesh.devices), lost, axis=0)
+        mesh = Mesh(survivors, ("stage", "data", "model"))
+        split = split.replan(cfg.num_layers, survivors.shape[0])
+        rcounters.replans += 1
+        ladder = [list(split.hop_codecs)]
+        if controller is not None:
+            for name in policy.tiers:
+                ladder.append([name] * len(split.hop_codecs))
+        runtimes.clear()
+        runtimes[0] = _make_runtime(ladder[0])
+        placed = runtimes[0].place_params(params)
+        gen += 1
+        gen_bytes[gen] = [0] * len(split.hop_codecs)
 
     def submit_group(group):
+        nonlocal sf_pending
         n_real = len(group)
         s_unpadded = group[0].input_ids.shape[1]
         counts = [c.num_loss_tokens for c in group]
@@ -275,34 +350,47 @@ def run_split_eval(
             ids = np.pad(ids, ((0, 0), (0, pad)))
             targets = np.pad(targets, ((0, 0), (0, pad)), constant_values=-100)
         ids, targets = jnp.asarray(ids), jnp.asarray(targets)
+        if sf_pending and group[0].index >= stage_failure.at_step:
+            sf_pending = False
+            for r in runtimes.values():
+                r.mark_stage_lost(stage_failure.stage)
         tier = controller.tier if controller is not None else 0
-        if tier not in runtimes:  # built on first demand, cached thereafter
-            runtimes[tier] = _make_runtime(ladder[tier])
-        art = runtimes[tier]
         # the chunk index drives the fault stream: same seed => same chunks
         # corrupted, run after run (ignored when the link is off)
         fstep = group[0].index
-        needs_t = [c.needs_importance for c in art.codecs]
-        if imp_fn is not None and any(needs_t):
-            imp = imp_fn(params, ids, hw)  # (L, W, S)
-            hop_imp = [(imp[cut] if len(group) > 1 else imp[cut, 0]) if need
-                       else None
-                       for cut, need in zip(split.cuts, needs_t)]
-            logits = art.forward(placed, ids, hop_importance=hop_imp,
-                                 fault_step=fstep)
-        else:
-            logits = art.forward(placed, ids, fault_step=fstep)
+
+        def _forward():
+            if tier not in runtimes:  # built on first demand, cached thereafter
+                runtimes[tier] = _make_runtime(ladder[tier])
+            art = runtimes[tier]
+            needs_t = [c.needs_importance for c in art.codecs]
+            if imp_fn is not None and any(needs_t):
+                imp = imp_fn(params, ids, hw)  # (L, W, S)
+                hop_imp = [(imp[cut] if len(group) > 1 else imp[cut, 0])
+                           if need else None
+                           for cut, need in zip(split.cuts, needs_t)]
+                logits = art.forward(placed, ids, hop_importance=hop_imp,
+                                     fault_step=fstep)
+            else:
+                logits = art.forward(placed, ids, fault_step=fstep)
+            return art, logits
+
+        try:
+            art, logits = _forward()
+        except StageLostError as e:
+            _eval_failover(e.stage)
+            art, logits = _forward()  # same chunk, re-planned boundary
         # this chunk's (still on-device) counters, for the tier controller
         chunk_counters = art._counter_accum[-1] if fault_on else None
         nlls = nll_from_logits(logits, targets, per_example=True)
         return (group, n_real, s_unpadded, counts, ids.shape, nlls, tier,
-                chunk_counters)
+                chunk_counters, art, gen)
 
     def drain_group(rec):
         nonlocal total_nll, n_tokens, fwd_tokens, real_fwd_tokens
         nonlocal degraded_chunks
         (group, n_real, s_unpadded, counts, (w, s_chunk), nlls, tier,
-         chunk_counters) = rec
+         chunk_counters, art, g) = rec
         # the per-example NLLs ride the mesh's data axis, which is the one
         # axis allowed to span processes in a multi-host run
         total_nll += float(fetch_global(nlls).astype(np.float64)
@@ -310,11 +398,11 @@ def run_split_eval(
         n_tokens += sum(counts)
         fwd_tokens += w * s_chunk
         real_fwd_tokens += n_real * s_unpadded
-        key = (tier, w, s_chunk)
+        key = (g, tier, w, s_chunk)
         if key not in bytes_cache:  # payloads are shape-determined
-            bytes_cache[key] = runtimes[tier].hop_bytes(w, s_chunk)
+            bytes_cache[key] = art.hop_bytes(w, s_chunk)
         for i, b in enumerate(bytes_cache[key]):
-            hop_bytes_total[i] += b
+            gen_bytes[g][i] += b
         if tier:
             degraded_chunks += 1
         if controller is not None:
@@ -336,6 +424,14 @@ def run_split_eval(
             if fault_on:
                 rec_out["tier"] = tier
             _emit(metrics_path, rec_out)
+        if wd is not None:
+            # pet-the-dog once per drained chunk; a stall past the deadline
+            # writes a best-effort resume checkpoint and raises typed
+            try:
+                wd.check(save_checkpoint, what="eval chunk")
+            except DecodeTimeout:
+                rcounters.watchdog_fires += 1
+                raise
 
     _run_pipelined(
         _iter_window_groups(token_ids, max_length, stride,
@@ -393,9 +489,27 @@ def run_split_eval(
         result["tier_switches"] = [list(t) for t in tier_log]
         result["final_tier"] = controller.tier if controller is not None else 0
         result["degraded_chunks"] = degraded_chunks
+    if recovery_on:
+        rec_block = {
+            "deadline_s": deadline_s,
+            "stage_failure": (dataclasses.asdict(stage_failure)
+                              if stage_failure is not None else None),
+            "counters": rcounters.as_dict(),
+            "plan_generations": gen + 1,
+        }
+        if rcounters.failovers:
+            rec_block["replanned_cuts"] = list(split.cuts)
+            rec_block["failover_hop_codecs"] = [c.name
+                                               for c in runtimes[0].codecs]
+            rec_block["failover_hop_bytes_total"] = {
+                str(g): list(b) for g, b in gen_bytes.items() if g > 0}
+            rec_block["failover_mesh"] = dict(mesh.shape)
+        result["recovery"] = rec_block
     if time_hops and rd.chunks:
         t_seq = seq if n_seq <= 1 else seq + (-seq) % n_seq
-        result["per_hop_ms"] = rt.time_hops(1, t_seq)
+        # after a failover, time the boundary that actually finished the run
+        result["per_hop_ms"] = (runtimes[0] if rcounters.failovers
+                                else rt).time_hops(1, t_seq)
     final_rec = {"final": True, "chunks": rd.chunks, "n_tokens": n_tokens,
                  "ppl": result["ppl"], "wall_s": wall,
                  "hop_bytes_total": hop_bytes_total,
@@ -403,6 +517,8 @@ def run_split_eval(
     if fault_on:
         final_rec["link_counters"] = result["link_counters"]
         final_rec["degraded_chunks"] = degraded_chunks
+    if recovery_on:
+        final_rec["failovers"] = rcounters.failovers
     _emit(metrics_path, final_rec)
     return result
 

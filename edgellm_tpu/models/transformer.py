@@ -28,6 +28,7 @@ from __future__ import annotations
 
 from typing import Callable, NamedTuple, Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -459,6 +460,27 @@ def init_cache(cfg: ModelConfig, batch: int, capacity: int,
     shape = (cfg.num_layers, batch, capacity, cfg.num_kv_heads, cfg.head_dim)
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
                    jnp.zeros((), jnp.int32))
+
+
+def cache_state_dict(cache) -> dict:
+    """Snapshot a decode cache as host numpy arrays keyed ``k``/``v``/
+    ``length`` — the serializable form the recovery checkpoint stores. Takes
+    either a :class:`KVCache` or the split runtime's ``{"k","v","length"}``
+    dict (both carry the position offset in ``length``)."""
+    if isinstance(cache, dict):
+        k, v, length = cache["k"], cache["v"], cache["length"]
+    else:
+        k, v, length = cache.k, cache.v, cache.length
+    return {"k": np.asarray(k), "v": np.asarray(v),
+            "length": np.asarray(length, np.int32)}
+
+
+def cache_from_state_dict(state: dict) -> dict:
+    """Rehydrate :func:`cache_state_dict` output to the on-device
+    ``{"k","v","length"}`` cache dict every decode runtime consumes (wrap in
+    :class:`KVCache` for the raw ``decode_step`` entry point)."""
+    return {"k": jnp.asarray(state["k"]), "v": jnp.asarray(state["v"]),
+            "length": jnp.asarray(state["length"], jnp.int32)}
 
 
 def prefill(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray,

@@ -330,6 +330,7 @@ class SplitRingRuntime:
         self._link = (FaultyLink(faults, self.policy)
                       if faults is not None and faults.enabled else None)
         self._counter_accum: list = []
+        self._lost_stage = None
         self.split = SplitConfig(cuts=tuple(cuts), hop_codecs=tuple(hop_codecs))
         self.codecs = apply_default_codec_backend(list(self.split.hop_codecs))
         bad = [c.name for c in self.codecs
@@ -357,6 +358,16 @@ class SplitRingRuntime:
         self.bounds = self.split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
         self._forward = self._build_forward()
+
+    def mark_stage_lost(self, stage: int) -> None:
+        """Same contract as ``SplitRuntime.mark_stage_lost``: subsequent
+        forwards raise the typed ``StageLostError``. (Failover re-planning
+        for the stage x seq composition is not implemented — the eval driver
+        rejects ``stage_failure`` with ``n_seq > 1`` up front.)"""
+        if not 0 <= stage < self.split.n_stages:
+            raise ValueError(f"stage {stage} out of range for "
+                             f"{self.split.n_stages} stages")
+        self._lost_stage = stage
 
     def place_params(self, params: dict) -> dict:
         """Stage-shard the stacked layer groups, replicate the rest (same
@@ -493,6 +504,10 @@ class SplitRingRuntime:
         ``SplitRuntime.forward``); each sequence shard additionally folds its
         shard index, so shards draw independent faults. Counters accumulate on
         the runtime — read with :meth:`link_counters`."""
+        if self._lost_stage is not None:
+            from ..serve.recovery import StageLostError
+
+            raise StageLostError(self._lost_stage)
         input_ids = jnp.asarray(input_ids)
         batch, seq = input_ids.shape
         n_hops = len(self.codecs)

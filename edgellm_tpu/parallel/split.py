@@ -38,6 +38,7 @@ from ..models.transformer import (block, block_decode, embed, unembed,
                                   precompute_rope, KVCache)
 from ..codecs.packing import get_wire_codec, WireCodec
 from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
+from ..serve.recovery import StageLostError
 from ..utils.jax_compat import shard_map, pcast_varying
 
 
@@ -262,6 +263,30 @@ class SplitConfig:
             raise ValueError(f"cuts {self.cuts} out of range for {num_layers} layers")
         return list(zip(edges[:-1], edges[1:]))
 
+    def replan(self, num_layers: int, n_stages: int,
+               codec=None) -> "SplitConfig":
+        """Recompute the split for a different stage count — the runtime
+        re-planning failover needs when a stage dies (MCAP-style: the split
+        point is a runtime decision, not a construction-time constant).
+
+        Cuts are evenly spaced over ``num_layers``; every new cut carries
+        ``codec`` (default: this plan's first hop codec — there is no
+        per-cut tuning signal left once the original cut set is gone).
+        ``n_stages == 1`` degenerates to the cut-free single-stage plan."""
+        if not 1 <= n_stages <= num_layers:
+            raise ValueError(
+                f"cannot re-plan {num_layers} layers onto {n_stages} stage(s)")
+        if n_stages == 1:
+            return SplitConfig(cuts=(), hop_codecs=())
+        if codec is None:
+            if not self.hop_codecs:
+                raise ValueError("re-planning a cut-free split needs an "
+                                 "explicit codec")
+            codec = self.hop_codecs[0]
+        cuts = tuple(round(i * num_layers / n_stages) - 1
+                     for i in range(1, n_stages))
+        return SplitConfig(cuts=cuts, hop_codecs=(codec,) * len(cuts))
+
 
 class SplitRuntime:
     """Executes a pipeline-split forward for one (cfg, split, mesh) combination.
@@ -288,6 +313,7 @@ class SplitRuntime:
         self._link = (FaultyLink(faults, self.policy)
                       if faults is not None and faults.enabled else None)
         self._counter_accum: list = []
+        self._lost_stage: Optional[int] = None
         self.bounds = split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
         self.codecs: list[WireCodec] = apply_default_codec_backend(
@@ -318,6 +344,29 @@ class SplitRuntime:
                     f"(n_data={mesh.shape['data']}); use per-token codecs or n_data=1")
         self._forward = self._build_forward()
         self._decode_fns_cache: dict = {}  # capacity -> (prefill_fn, step_fn)
+
+    # ---------- stage liveness ----------
+
+    def mark_stage_lost(self, stage: int) -> None:
+        """Record a dark stage (failure injection, or a caller's own device
+        health signal): every subsequent forward/prefill/step raises the
+        typed :class:`StageLostError` until the caller fails over — re-plans
+        the split onto the survivors (``SplitConfig.replan``) and rebuilds
+        the runtime. Host-side state only: the compiled executables are
+        untouched, so a runtime that never loses a stage runs the exact
+        pre-recovery graph."""
+        if not 0 <= stage < self.split.n_stages:
+            raise ValueError(f"stage {stage} out of range for "
+                             f"{self.split.n_stages} stages")
+        self._lost_stage = stage
+
+    @property
+    def lost_stage(self) -> Optional[int]:
+        return self._lost_stage
+
+    def _check_alive(self) -> None:
+        if self._lost_stage is not None:
+            raise StageLostError(self._lost_stage)
 
     # ---------- parameter placement ----------
 
@@ -457,6 +506,7 @@ class SplitRuntime:
         index so each chunk draws distinct faults; a traced scalar, so it
         never retraces). Ignored when faults are off. Per-hop fault counters
         accumulate on the runtime — read them with :meth:`link_counters`."""
+        self._check_alive()
         n_hops = len(self.codecs)
         batch, seq = input_ids.shape
         imps = list(hop_importance) if hop_importance is not None else [None] * n_hops
@@ -667,6 +717,7 @@ class SplitRuntime:
         Returns (logits (B, S, V) fp32, cache dict) — feed the cache to
         :meth:`decode_step`. Cache k/v: (n_stages, sz, B, capacity, KV, hd),
         sharded P("stage") like the layer groups they mirror."""
+        self._check_alive()
         self._check_decode_supported()
         s = input_ids.shape[1]
         if not 0 < s <= capacity:
@@ -687,6 +738,7 @@ class SplitRuntime:
         single-token hidden state through its wire codec (under faults, via
         the sealed/verified link, keyed by the cache fill level). Returns
         (logits (B, V) fp32, updated cache)."""
+        self._check_alive()
         capacity = cache["k"].shape[3]
         _, step_fn = self._decode_fns(int(capacity))
         if self._link is None:

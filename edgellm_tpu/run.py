@@ -105,6 +105,7 @@ _PARAM_KEYS = {
     "cuts": "split", "hop_codecs": "split", "importance_method": "split",
     "n_seq": "split", "n_data": "split", "n_model": "split",
     "faults": "split", "link_policy": "split",
+    "deadline": "split", "stage_failure": "split", "recovery": "split",
     "max_compiles": "distances",
 }
 _EXPERIMENTS = ("", "initial", "last_row", "relevance", "split", "distances")
@@ -130,8 +131,11 @@ def _validate_params_json(p: dict) -> None:
     exp = p.get("experiment", "")
     if exp not in _EXPERIMENTS:
         die(f"unknown experiment {exp!r}; options: {list(_EXPERIMENTS)}")
-    if exp != "split" and ("faults" in p or "link_policy" in p):
-        die("faults/link_policy only apply to experiment 'split'")
+    if exp != "split" and ("faults" in p or "link_policy" in p
+                           or "deadline" in p or "stage_failure" in p
+                           or "recovery" in p):
+        die("faults/link_policy/deadline/stage_failure/recovery only apply "
+            "to experiment 'split'")
     for k in _REQUIRED.get(exp, ()):
         if k not in p:
             die(f"experiment {exp!r} requires key {k!r}")
@@ -199,6 +203,45 @@ def _validate_params_json(p: dict) -> None:
                         get_wire_codec(t)
                     except ValueError as e:
                         die(f"link_policy.tiers: {e}")
+        if "deadline" in p:
+            d = p["deadline"]
+            if isinstance(d, bool) or not isinstance(d, (int, float)) or d <= 0:
+                die(f"deadline must be a positive number of seconds, got {d!r}")
+        if "stage_failure" in p:
+            from .serve.recovery import StageFailure
+
+            sf = p["stage_failure"]
+            if not isinstance(sf, dict):
+                die(f"stage_failure must be an object of StageFailure fields, "
+                    f"got {sf!r}")
+            fields = {f.name for f in dataclasses.fields(StageFailure)}
+            bad = sorted(set(sf) - fields)
+            if bad:
+                die(f"stage_failure: unknown field(s) {bad}; "
+                    f"known: {sorted(fields)}")
+            try:
+                obj = StageFailure(**sf)
+            except (TypeError, ValueError) as e:
+                die(f"stage_failure: {e}")
+            if obj.stage > len(p["cuts"]):
+                die(f"stage_failure.stage {obj.stage} out of range for "
+                    f"{len(p['cuts']) + 1} pipeline stage(s)")
+            if p.get("n_seq", 1) > 1:
+                die("stage_failure needs the plain split runtime (n_seq == 1)")
+        if "recovery" in p:
+            r = p["recovery"]
+            if not isinstance(r, dict):
+                die(f"recovery must be an object, got {r!r}")
+            bad = sorted(set(r) - {"replan", "max_failovers"})
+            if bad:
+                die(f"recovery: unknown field(s) {bad}; "
+                    f"known: ['max_failovers', 'replan']")
+            if "replan" in r and not isinstance(r["replan"], bool):
+                die(f"recovery.replan must be a boolean, got {r['replan']!r}")
+            mf = r.get("max_failovers", 1)
+            if isinstance(mf, bool) or not isinstance(mf, int) or mf < 1:
+                die(f"recovery.max_failovers must be a positive integer, "
+                    f"got {mf!r}")
 
 
 def main(argv=None) -> int:
@@ -227,6 +270,12 @@ def main(argv=None) -> int:
                          "DIR (view with TensorBoard/Perfetto; includes "
                          "ppermute hops and Pallas codec kernels)")
     ap.add_argument("--checkpoint-every", type=int, default=1000)
+    ap.add_argument("--deadline-s", type=float,
+                    help="split experiment: per-chunk watchdog deadline in "
+                         "seconds — a stalled eval writes a best-effort resume "
+                         "checkpoint and exits with a typed DecodeTimeout "
+                         "instead of hanging (overrides params.json "
+                         "\"deadline\")")
     ap.add_argument("--distributed", action="store_true",
                     help="join a multi-host run via jax.distributed.initialize() "
                          "before touching devices; split meshes become "
@@ -398,7 +447,11 @@ def main(argv=None) -> int:
                 checkpoint_every=args.checkpoint_every,
                 metrics_path=out("split_metrics.jsonl"),
                 faults=params_json.get("faults"),
-                link_policy=params_json.get("link_policy"))
+                link_policy=params_json.get("link_policy"),
+                deadline_s=(args.deadline_s if args.deadline_s is not None
+                            else params_json.get("deadline")),
+                stage_failure=params_json.get("stage_failure"),
+                recovery=params_json.get("recovery"))
             with open(out("split_eval_results.json"), "w") as f:
                 json.dump(result, f, indent=1)
             print(json.dumps(result))

@@ -1,3 +1,12 @@
-from .decode import generate, generate_split, decode_step_cache_size
+from .decode import (decode_step_cache_size, generate, generate_split,
+                     resume_split)
+from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
+                       LocalRuntime, RecoveryConfig, RecoveryCounters,
+                       StageFailure, StageLostError, Watchdog)
 
-__all__ = ["generate", "generate_split", "decode_step_cache_size"]
+__all__ = [
+    "generate", "generate_split", "resume_split", "decode_step_cache_size",
+    "CheckpointError", "DecodeCheckpoint", "DecodeTimeout", "LocalRuntime",
+    "RecoveryConfig", "RecoveryCounters", "StageFailure", "StageLostError",
+    "Watchdog",
+]

@@ -17,17 +17,33 @@ from ``categorical(logits / temperature)`` with a per-step ``fold_in`` of the
 caller's key, so a fixed key is reproducible and steps are decorrelated. The
 temperature is a static jit arg — the greedy executable contains no RNG at
 all.
+
+Survivability (``recovery=`` on both loops, see ``serve.recovery``): the
+same loop can periodically snapshot its full generation state (KV cache,
+position offset, RNG key, token prefix, fault counters) to an atomic
+:class:`~edgellm_tpu.serve.recovery.DecodeCheckpoint`, guard each step with a
+monotonic watchdog, survive an injected (or real) whole-stage loss by
+re-planning the split onto the survivors and recomputing the lost KV state
+from the generation prefix, and resume from a checkpoint token-identically
+(:func:`resume_split`). With ``recovery=None`` — or a config with every
+feature off — the loop drives the exact same runtime executables as before:
+recovery is host-side orchestration, never a different graph.
 """
 from __future__ import annotations
 
 import time
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..models.configs import ModelConfig
-from ..models.transformer import decode_step, prefill
+from ..models.transformer import (cache_from_state_dict, cache_state_dict,
+                                  decode_step, prefill)
+from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
+                       LocalRuntime, RecoveryConfig, RecoveryCounters,
+                       StageLostError, Watchdog, runtime_plan_meta)
 
 
 def _sample(logits, key, temperature: float):
@@ -61,25 +77,12 @@ def decode_step_cache_size() -> int:
     return _step_jit._cache_size()
 
 
-def generate(cfg: ModelConfig, params: dict, prompt_ids, max_new_tokens: int,
-             *,
-             capacity: Optional[int] = None,
-             temperature: float = 0.0,
-             rng_key: Optional[jax.Array] = None,
-             compute_dtype=None,
-             stats: Optional[dict] = None) -> jnp.ndarray:
-    """Generate ``max_new_tokens`` per batch row after a KV-cached prefill.
-
-    prompt_ids: (B, S) int token ids. Returns (B, max_new_tokens) int32.
-    ``capacity`` (static; default exactly prompt+new) bounds the cache —
-    prompts that would overflow it raise instead of silently wrapping.
-    ``stats``, when given, is filled with timing and the per-step jit
-    cache-miss delta (0 on a warm shape, 1 on a cold one).
-    """
+def _validate_decode_args(prompt_ids, max_new_tokens, capacity, temperature,
+                          rng_key):
     prompt_ids = jnp.asarray(prompt_ids)
     if prompt_ids.ndim != 2:
         raise ValueError(f"prompt_ids must be (B, S), got {prompt_ids.shape}")
-    b, s = prompt_ids.shape
+    _, s = prompt_ids.shape
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
     capacity = s + max_new_tokens if capacity is None else int(capacity)
@@ -91,6 +94,39 @@ def generate(cfg: ModelConfig, params: dict, prompt_ids, max_new_tokens: int,
     if temperature < 0.0:
         raise ValueError("temperature must be >= 0")
     key = jax.random.key(0) if rng_key is None else rng_key
+    return prompt_ids, capacity, temperature, key
+
+
+def generate(cfg: ModelConfig, params: dict, prompt_ids, max_new_tokens: int,
+             *,
+             capacity: Optional[int] = None,
+             temperature: float = 0.0,
+             rng_key: Optional[jax.Array] = None,
+             compute_dtype=None,
+             stats: Optional[dict] = None,
+             recovery: Optional[RecoveryConfig] = None) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` per batch row after a KV-cached prefill.
+
+    prompt_ids: (B, S) int token ids. Returns (B, max_new_tokens) int32.
+    ``capacity`` (static; default exactly prompt+new) bounds the cache —
+    prompts that would overflow it raise instead of silently wrapping.
+    ``stats``, when given, is filled with timing and the per-step jit
+    cache-miss delta (0 on a warm shape, 1 on a cold one).
+
+    ``recovery``: a :class:`~edgellm_tpu.serve.recovery.RecoveryConfig`
+    routes the generation through the survivable loop (checkpointing +
+    watchdog) on a :class:`LocalRuntime` adapter around the same
+    ``prefill``/``decode_step`` math; stage failover does not apply on a
+    single device. ``recovery=None`` is the original loop, untouched.
+    """
+    prompt_ids, capacity, temperature, key = _validate_decode_args(
+        prompt_ids, max_new_tokens, capacity, temperature, rng_key)
+    b, s = prompt_ids.shape
+    if recovery is not None:
+        rt = LocalRuntime(cfg, compute_dtype)
+        return _survivable_loop(rt, params, prompt_ids, max_new_tokens,
+                                capacity, temperature, key, 0, stats,
+                                recovery, raw_params=params)
     misses0 = decode_step_cache_size()
 
     t0 = time.monotonic()
@@ -129,7 +165,9 @@ def generate_split(rt, placed_params: dict, prompt_ids, max_new_tokens: int,
                    temperature: float = 0.0,
                    rng_key: Optional[jax.Array] = None,
                    fault_step: int = 0,
-                   stats: Optional[dict] = None) -> jnp.ndarray:
+                   stats: Optional[dict] = None,
+                   recovery: Optional[RecoveryConfig] = None,
+                   raw_params: Optional[dict] = None) -> jnp.ndarray:
     """``generate`` over the pipeline-SPLIT decode runtime: one split prefill,
     then O(1) :meth:`SplitRuntime.decode_step` calls, every emitted token
     crossing each cut as a packed wire payload — and, when the runtime was
@@ -142,22 +180,21 @@ def generate_split(rt, placed_params: dict, prompt_ids, max_new_tokens: int,
     ``stats`` gains the same timing fields as ``generate`` plus, under faults,
     ``link_counters`` — the per-hop detected/retried/recovered/substituted
     totals incurred by THIS call.
+
+    ``recovery`` routes the call through the survivable loop: periodic
+    :class:`DecodeCheckpoint` snapshots, a per-step watchdog, stage-failure
+    injection, and boundary re-planning failover (which needs ``raw_params``
+    — the unplaced parameter pytree — to re-place onto the surviving
+    devices). ``recovery=None`` is the original loop on the exact same
+    runtime executables.
     """
-    prompt_ids = jnp.asarray(prompt_ids)
-    if prompt_ids.ndim != 2:
-        raise ValueError(f"prompt_ids must be (B, S), got {prompt_ids.shape}")
+    prompt_ids, capacity, temperature, key = _validate_decode_args(
+        prompt_ids, max_new_tokens, capacity, temperature, rng_key)
     b, s = prompt_ids.shape
-    if max_new_tokens < 1:
-        raise ValueError("max_new_tokens must be >= 1")
-    capacity = s + max_new_tokens if capacity is None else int(capacity)
-    if s + max_new_tokens > capacity:
-        raise ValueError(
-            f"cache capacity overflow: prompt {s} + {max_new_tokens} new "
-            f"tokens > capacity {capacity}")
-    temperature = float(temperature)
-    if temperature < 0.0:
-        raise ValueError("temperature must be >= 0")
-    key = jax.random.key(0) if rng_key is None else rng_key
+    if recovery is not None:
+        return _survivable_loop(rt, placed_params, prompt_ids, max_new_tokens,
+                                capacity, temperature, key, fault_step, stats,
+                                recovery, raw_params=raw_params)
     counters0 = rt.link_counters() if hasattr(rt, "link_counters") else None
 
     t0 = time.monotonic()
@@ -192,3 +229,261 @@ def generate_split(rt, placed_params: dict, prompt_ids, max_new_tokens: int,
                                      else v - counters0[k])]
                 for k, v in counters1.items()}
     return out
+
+
+# ---------------------------------------------------------------------------
+# the survivable loop: checkpoints, watchdog, stage failover, resume
+# ---------------------------------------------------------------------------
+
+
+def _write_checkpoint(rec: RecoveryConfig, rt, counters: RecoveryCounters,
+                      prompt_ids, toks: list, cache, key, t: int,
+                      run_meta: dict) -> None:
+    """Snapshot everything step t+1 needs — token-identically — to the
+    atomic checkpoint file. ``toks`` holds steps 0..t; the cache holds the
+    prompt plus steps 0..t-1 (step t's token has not been fed back yet),
+    which is exactly the loop state at the top of iteration t+1."""
+    arrays = {
+        "prompt_ids": np.asarray(prompt_ids, np.int32),
+        "tokens": np.stack([np.asarray(x) for x in toks], axis=1)
+        .astype(np.int32),
+        "rng_key": np.asarray(jax.random.key_data(key)),
+    }
+    cs = cache_state_dict(cache)
+    arrays.update({"cache/k": cs["k"], "cache/v": cs["v"],
+                   "cache/length": cs["length"]})
+    meta = {**runtime_plan_meta(rt), **run_meta, "step": int(t),
+            "recovery_counters": counters.as_dict()}
+    link = rt.link_counters() if hasattr(rt, "link_counters") else None
+    if link is not None:
+        meta["link_counters"] = {k: [int(x) for x in v]
+                                 for k, v in link.items()}
+    DecodeCheckpoint(arrays, meta).save(rec.checkpoint_path)
+    counters.checkpoints_written += 1
+
+
+def _decode_failover(rt, raw_params, lost_stage: int, prompt_ids, toks: list,
+                     capacity: int, fault_step: int,
+                     counters: RecoveryCounters, rec: RecoveryConfig):
+    """Re-plan the split onto the surviving stage(s) and rebuild the decode
+    state there. The lost stage's KV cache is unrecoverable (its boundary
+    inputs died with it), so the honest migration is a re-prefill of the
+    whole generation prefix — prompt plus every token sampled so far — on
+    the new plan; the re-prefill's last-position logits are exactly what the
+    failed step would have produced, so the caller samples from them with
+    the step's own folded key and continues. Returns
+    (new_rt, new_placed, cache, last_logits)."""
+    if not rec.replan:
+        raise StageLostError(lost_stage)
+    if counters.failovers >= rec.max_failovers:
+        raise StageLostError(lost_stage)
+    if raw_params is None:
+        raise ValueError(
+            "stage failover needs raw_params= (the unplaced parameter "
+            "pytree) to re-place weights onto the surviving devices")
+    counters.failovers += 1
+    grid = np.asarray(rt.mesh.devices)  # (stage, data, model)
+    survivors = np.delete(grid, lost_stage, axis=0)
+    cfg = rt.cfg
+    if survivors.shape[0] >= 2:
+        # lazy import: serve -> parallel only on the failover path keeps the
+        # module layering acyclic (parallel imports serve.recovery's error)
+        from jax.sharding import Mesh
+
+        from ..parallel.split import SplitRuntime
+
+        new_split = rt.split.replan(cfg.num_layers, survivors.shape[0])
+        new_rt = SplitRuntime(cfg, new_split,
+                              Mesh(survivors, ("stage", "data", "model")),
+                              faults=rt.faults, policy=rt.policy)
+    else:
+        new_rt = LocalRuntime(cfg)  # one survivor: nothing left to cut
+    counters.replans += 1
+    new_placed = new_rt.place_params(raw_params)
+    # via host: the sampled tokens are committed to the dead mesh, and the
+    # re-planned runtime lives on a different device set
+    prompt_np = np.asarray(prompt_ids)
+    prefix = jnp.asarray(
+        prompt_np if not toks else
+        np.concatenate([prompt_np,
+                        np.stack([np.asarray(x) for x in toks], axis=1)],
+                       axis=1))
+    logits, cache = new_rt.prefill_decode(new_placed, prefix, capacity,
+                                          fault_step=fault_step)
+    counters.recompute_tokens += int(prefix.shape[0] * prefix.shape[1])
+    return new_rt, new_placed, cache, logits[:, -1]
+
+
+def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
+                     capacity: int, temperature: float, key, fault_step: int,
+                     stats: Optional[dict], rec: RecoveryConfig,
+                     raw_params: Optional[dict],
+                     resume_state=None, resumed: bool = False) -> jnp.ndarray:
+    """The decode loop with recovery orchestration around the unchanged
+    runtime executables. ``resume_state`` = (last_done_step, toks, cache)
+    continues a checkpointed generation from step ``last_done_step + 1``."""
+    counters = RecoveryCounters()
+    wd = (Watchdog(rec.deadline_s, clock=rec.clock)
+          if rec.deadline_s is not None else None)
+    b, s = prompt_ids.shape
+    sf = rec.stage_failure
+    fail_pending = sf is not None
+    run_meta = {"capacity": int(capacity), "temperature": float(temperature),
+                "max_new_tokens": int(max_new_tokens),
+                "fault_step": int(fault_step), "prompt_len": int(s),
+                "batch": int(b)}
+    counters0 = rt.link_counters() if hasattr(rt, "link_counters") else None
+    halted_at = None
+
+    def post_step(t, toks, cache) -> bool:
+        """halt hook, periodic checkpoint, watchdog — in that order; returns
+        True when the loop must stop (simulated kill)."""
+        if rec.halt_at_step is not None and rec.halt_at_step == t:
+            _write_checkpoint(rec, rt, counters, prompt_ids, toks, cache,
+                              key, t, run_meta)
+            return True
+        if (rec.checkpoint_every and rec.checkpoint_path
+                and t % rec.checkpoint_every == 0):
+            _write_checkpoint(rec, rt, counters, prompt_ids, toks, cache,
+                              key, t, run_meta)
+        if wd is not None:
+            ckpt_fn = ((lambda: _write_checkpoint(
+                rec, rt, counters, prompt_ids, toks, cache, key, t, run_meta))
+                if rec.checkpoint_path else None)
+            try:
+                wd.check(ckpt_fn)
+            except DecodeTimeout:
+                counters.watchdog_fires += 1
+                if stats is not None:
+                    stats["recovery_counters"] = counters.as_dict()
+                raise
+        return False
+
+    t0 = time.monotonic()
+    if wd is not None:
+        wd.arm()
+    if resume_state is None:
+        if fail_pending and sf.at_step == 0:
+            rt.mark_stage_lost(sf.stage)
+        try:
+            logits, cache = rt.prefill_decode(placed, prompt_ids, capacity,
+                                              fault_step=fault_step)
+            last = logits[:, -1]
+        except StageLostError as e:
+            fail_pending = False
+            rt, placed, cache, last = _decode_failover(
+                rt, raw_params, e.stage, prompt_ids, [], capacity,
+                fault_step, counters, rec)
+        tok = _sample(last, jax.random.fold_in(key, 0), temperature)
+        jax.block_until_ready(tok)
+        t1 = time.monotonic()
+        toks = [tok]
+        start_t = 1
+        if post_step(0, toks, cache):
+            halted_at = 0
+    else:
+        last_done, toks, cache = resume_state
+        tok = toks[-1]
+        t1 = t0
+        start_t = last_done + 1
+
+    if halted_at is None:
+        for t in range(start_t, max_new_tokens):
+            if fail_pending and sf.at_step == t:
+                rt.mark_stage_lost(sf.stage)
+            try:
+                step_logits, cache = rt.decode_step(placed, cache, tok)
+                tok = _sample(step_logits, jax.random.fold_in(key, t),
+                              temperature)
+            except StageLostError as e:
+                fail_pending = False
+                rt, placed, cache, last = _decode_failover(
+                    rt, raw_params, e.stage, prompt_ids, toks, capacity,
+                    fault_step, counters, rec)
+                tok = _sample(last, jax.random.fold_in(key, t), temperature)
+            toks.append(tok)
+            if post_step(t, toks, cache):
+                halted_at = t
+                break
+
+    # assemble via host: after a failover the prefix is committed to the dead
+    # mesh and the tail to the survivors' — jnp.stack would refuse the mix
+    out = jnp.asarray(np.stack([np.asarray(x) for x in toks], axis=1))
+    jax.block_until_ready(out)
+    t2 = time.monotonic()
+    if resumed and halted_at is None:
+        counters.resume_ok += 1
+
+    if stats is not None:
+        steps = len(toks) - (0 if resume_state is not None else 1)
+        stats.update(
+            capacity=capacity,
+            prefill_s=t1 - t0,
+            decode_s=t2 - t1,
+            decode_steps=steps,
+            decode_tokens_per_s=(b * steps / (t2 - t1)) if steps
+            and t2 > t1 else 0.0,
+        )
+        if halted_at is not None:
+            stats["halted_at_step"] = halted_at
+        stats["recovery_counters"] = counters.as_dict()
+        counters1 = rt.link_counters() if hasattr(rt, "link_counters") else None
+        if counters1 is not None:
+            # after a failover the runtime is new, so deltas vs the original
+            # runtime's baseline are meaningless — report absolute totals
+            stats["link_counters"] = {
+                k: [int(x) for x in
+                    (v if counters0 is None or counters.failovers
+                     else v - counters0[k])]
+                for k, v in counters1.items()}
+    return out
+
+
+def resume_split(rt, placed_params: dict, checkpoint_path: str, *,
+                 stats: Optional[dict] = None,
+                 recovery: Optional[RecoveryConfig] = None,
+                 raw_params: Optional[dict] = None) -> jnp.ndarray:
+    """Resume a checkpointed generation and return the FULL (B, max_new)
+    token matrix — the checkpointed prefix plus the tokens decoded here,
+    token-identical to the uninterrupted same-seed run.
+
+    ``rt``/``placed_params`` must match the checkpoint's plan and model
+    signature (validated; a mismatch is a typed :class:`CheckpointError` —
+    same-plan resume restores the KV cache bit-exactly instead of
+    recomputing it). ``recovery`` optionally re-arms checkpointing/watchdog/
+    failover for the resumed tail; its ``stage_failure`` steps are absolute
+    decode-step indices, comparable to the checkpoint's ``step``. Works for
+    both split runtimes and :class:`LocalRuntime` (unsplit ``generate``
+    checkpoints)."""
+    ckpt = DecodeCheckpoint.load(checkpoint_path)
+    meta = ckpt.meta
+    want = runtime_plan_meta(rt)
+    for k, label in (("mode", "runtime mode"), ("model", "model signature"),
+                     ("cuts", "split cuts"), ("hop_codecs", "hop codecs")):
+        if meta.get(k) != want.get(k):
+            raise CheckpointError(
+                f"checkpoint {checkpoint_path} was written for {label} "
+                f"{meta.get(k)!r}, the resuming runtime has {want.get(k)!r}; "
+                f"rebuild the runtime to match (or re-plan explicitly)")
+    prompt_ids = jnp.asarray(ckpt.arrays["prompt_ids"])
+    tokens = ckpt.arrays["tokens"]  # (B, step+1)
+    key = jax.random.wrap_key_data(jnp.asarray(ckpt.arrays["rng_key"]))
+    cache = cache_from_state_dict({"k": ckpt.arrays["cache/k"],
+                                   "v": ckpt.arrays["cache/v"],
+                                   "length": ckpt.arrays["cache/length"]})
+    toks = [jnp.asarray(tokens[:, i]) for i in range(tokens.shape[1])]
+    step = int(meta["step"])
+    if len(toks) != step + 1:
+        raise CheckpointError(
+            f"checkpoint {checkpoint_path} is inconsistent: step {step} "
+            f"with {len(toks)} sampled tokens")
+    rec = recovery if recovery is not None else RecoveryConfig()
+    if stats is not None:
+        stats["resumed_from_step"] = step
+        if "link_counters" in meta:
+            stats["checkpoint_link_counters"] = meta["link_counters"]
+    return _survivable_loop(
+        rt, placed_params, prompt_ids, int(meta["max_new_tokens"]),
+        int(meta["capacity"]), float(meta["temperature"]), key,
+        int(meta["fault_step"]), stats, rec, raw_params,
+        resume_state=(step, toks, cache), resumed=True)

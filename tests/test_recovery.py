@@ -1,0 +1,451 @@
+"""Survivable split decode: checkpoints, stage failover, watchdogs (PR 3).
+
+Correctness anchors, in order of importance:
+
+- kill-and-resume is TOKEN-IDENTICAL: a generation halted at step k with a
+  :class:`DecodeCheckpoint` and resumed from disk emits the exact token
+  matrix of the uninterrupted same-seed run, for k at the first, a middle,
+  and the last decode step (the checkpoint restores the KV cache, position
+  offsets, RNG key, and sampled prefix bit-exactly — no recompute);
+- a whole-stage loss mid-decode completes the generation on a re-planned
+  boundary with non-zero failover counters, and — with lossless hops — the
+  output matches the clean run exactly (the prefix re-prefill reproduces
+  what the dead pipeline would have computed);
+- the zero-recovery config builds the exact pre-recovery graph: enabling
+  ``recovery=RecoveryConfig()`` with every feature off changes nothing,
+  bit for bit;
+- checkpoint I/O is self-verifying: bit-exact round-trips per dtype, and
+  truncation/corruption/foreign files die with a typed
+  :class:`CheckpointError` naming the problem;
+- the watchdog fires deterministically on an injected fake clock, in both
+  the decode loop and the eval harness.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.models import tiny_config, init_params
+from edgellm_tpu.parallel import SplitConfig, SplitRuntime, make_stage_mesh
+from edgellm_tpu.serve import (CheckpointError, DecodeCheckpoint,
+                               DecodeTimeout, LocalRuntime, RecoveryConfig,
+                               StageFailure, StageLostError, Watchdog,
+                               generate, generate_split, resume_split)
+
+SPLIT_CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4,
+                        vocab_size=128)
+MAX_NEW = 8
+TEMP = 0.7
+
+
+def _ids(cfg, batch, seq, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, seq)))
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(SPLIT_CFG, jax.random.key(1))
+    ids = _ids(SPLIT_CFG, 2, 14, seed=21)
+    split = SplitConfig(cuts=(2,), hop_codecs=("fp32",))
+    rt = SplitRuntime(SPLIT_CFG, split, make_stage_mesh(2))
+    placed = rt.place_params(params)
+    key = jax.random.key(7)
+    clean = generate_split(rt, placed, ids, MAX_NEW, temperature=TEMP,
+                           rng_key=key)
+    return dict(params=params, ids=ids, split=split, rt=rt, placed=placed,
+                key=key, clean=np.asarray(clean))
+
+
+# ---------------------------------------------------------------------------
+# checkpoint container: bit-exact round trip, typed failures
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_bit_exact(tmp_path):
+    import ml_dtypes
+
+    rng = np.random.default_rng(0)
+    arrays = {
+        "f32": rng.standard_normal((3, 5)).astype(np.float32),
+        "bf16": rng.standard_normal((2, 4, 4)).astype(ml_dtypes.bfloat16),
+        "i32": rng.integers(-1000, 1000, (7,), dtype=np.int32),
+        "u32": rng.integers(0, 2**32, (2, 2), dtype=np.uint32),
+        "scalar": np.int32(42),
+    }
+    meta = {"step": 3, "nested": {"cuts": [2], "temperature": 0.7}}
+    path = str(tmp_path / "ck.bin")
+    DecodeCheckpoint(arrays, meta).save(path)
+    assert not os.path.exists(path + ".part")  # atomic rename, no debris
+    ck = DecodeCheckpoint.load(path)
+    assert ck.meta == meta
+    assert set(ck.arrays) == set(arrays)
+    for name, a in arrays.items():
+        b = ck.arrays[name]
+        assert b.dtype == np.asarray(a).dtype and b.shape == np.asarray(a).shape
+        assert np.asarray(a).tobytes() == b.tobytes(), name  # bit-exact
+
+
+def test_checkpoint_truncated_raises(tmp_path):
+    path = str(tmp_path / "ck.bin")
+    DecodeCheckpoint({"a": np.arange(100, dtype=np.float32)}, {}).save(path)
+    blob = open(path, "rb").read()
+    open(path, "wb").write(blob[: len(blob) // 2])
+    with pytest.raises(CheckpointError, match="truncated"):
+        DecodeCheckpoint.load(path)
+    open(path, "wb").write(blob[:8])  # shorter than the fixed header
+    with pytest.raises(CheckpointError, match="truncated"):
+        DecodeCheckpoint.load(path)
+
+
+def test_checkpoint_corrupted_raises(tmp_path):
+    path = str(tmp_path / "ck.bin")
+    DecodeCheckpoint({"a": np.arange(100, dtype=np.float32)}, {}).save(path)
+    blob = bytearray(open(path, "rb").read())
+    blob[-5] ^= 0xFF  # flip payload bits; length still matches
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(CheckpointError, match="CRC32|corrupted"):
+        DecodeCheckpoint.load(path)
+
+
+def test_checkpoint_bad_magic_and_missing(tmp_path):
+    path = str(tmp_path / "notack.bin")
+    open(path, "wb").write(b"\x00" * 64)
+    with pytest.raises(CheckpointError, match="magic"):
+        DecodeCheckpoint.load(path)
+    with pytest.raises(CheckpointError, match="cannot read"):
+        DecodeCheckpoint.load(str(tmp_path / "does_not_exist.bin"))
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume: token-identical at first/mid/last step
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [0, 3, MAX_NEW - 1])
+def test_kill_and_resume_token_identical(setup, tmp_path, k):
+    s = setup
+    ckpt = str(tmp_path / "gen.ckpt")
+    stats = {}
+    part = generate_split(
+        s["rt"], s["placed"], s["ids"], MAX_NEW, temperature=TEMP,
+        rng_key=s["key"],
+        recovery=RecoveryConfig(checkpoint_path=ckpt, halt_at_step=k),
+        stats=stats)
+    assert stats["halted_at_step"] == k
+    assert part.shape == (2, k + 1)
+    assert np.array_equal(np.asarray(part), s["clean"][:, : k + 1])
+    rstats = {}
+    full = resume_split(s["rt"], s["placed"], ckpt, stats=rstats)
+    assert rstats["resumed_from_step"] == k
+    assert rstats["recovery_counters"]["resume_ok"] == 1
+    assert np.array_equal(np.asarray(full), s["clean"])  # token-identical
+
+
+def test_resume_refuses_mismatched_plan(setup, tmp_path):
+    s = setup
+    ckpt = str(tmp_path / "gen.ckpt")
+    generate_split(s["rt"], s["placed"], s["ids"], MAX_NEW, temperature=TEMP,
+                   rng_key=s["key"],
+                   recovery=RecoveryConfig(checkpoint_path=ckpt,
+                                           halt_at_step=2))
+    other = SplitRuntime(SPLIT_CFG,
+                         SplitConfig(cuts=(4,), hop_codecs=("fp32",)),
+                         make_stage_mesh(2))
+    with pytest.raises(CheckpointError, match="split cuts"):
+        resume_split(other, other.place_params(s["params"]), ckpt)
+
+
+def test_periodic_checkpoints_written(setup, tmp_path):
+    s = setup
+    ckpt = str(tmp_path / "gen.ckpt")
+    stats = {}
+    out = generate_split(s["rt"], s["placed"], s["ids"], MAX_NEW,
+                         temperature=TEMP, rng_key=s["key"],
+                         recovery=RecoveryConfig(checkpoint_path=ckpt,
+                                                 checkpoint_every=2),
+                         stats=stats)
+    assert np.array_equal(np.asarray(out), s["clean"])
+    assert stats["recovery_counters"]["checkpoints_written"] >= 3
+    # the last periodic write lands at step 6; resuming it replays the tail
+    full = resume_split(s["rt"], s["placed"], ckpt)
+    assert np.array_equal(np.asarray(full), s["clean"])
+
+
+# ---------------------------------------------------------------------------
+# stage failure + failover re-planning
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("at_step", [0, 3, MAX_NEW - 1])
+def test_stage_failure_fails_over_and_completes(setup, at_step):
+    s = setup
+    # 3 stages so the failover re-plans onto a REAL 2-stage split
+    rt3 = SplitRuntime(SPLIT_CFG,
+                       SplitConfig(cuts=(1, 3), hop_codecs=("fp32", "fp32")),
+                       make_stage_mesh(3))
+    stats = {}
+    out = generate_split(rt3, rt3.place_params(s["params"]), s["ids"],
+                         MAX_NEW, temperature=TEMP, rng_key=s["key"],
+                         recovery=RecoveryConfig(
+                             stage_failure=StageFailure(stage=2,
+                                                        at_step=at_step)),
+                         raw_params=s["params"], stats=stats)
+    rc = stats["recovery_counters"]
+    assert rc["failovers"] == 1 and rc["replans"] == 1
+    assert rc["recompute_tokens"] > 0
+    # lossless hops: the re-planned run must match the clean output exactly
+    assert np.array_equal(np.asarray(out), s["clean"])
+
+
+def test_stage_failure_to_single_survivor_uses_local_runtime(setup):
+    s = setup
+    rt2 = SplitRuntime(SPLIT_CFG, s["split"], make_stage_mesh(2))
+    stats = {}
+    out = generate_split(rt2, rt2.place_params(s["params"]), s["ids"],
+                         MAX_NEW, temperature=TEMP, rng_key=s["key"],
+                         recovery=RecoveryConfig(
+                             stage_failure=StageFailure(stage=0, at_step=2)),
+                         raw_params=s["params"], stats=stats)
+    assert stats["recovery_counters"]["failovers"] == 1
+    assert np.array_equal(np.asarray(out), s["clean"])
+
+
+def test_stage_failure_without_raw_params_raises(setup):
+    s = setup
+    rt2 = SplitRuntime(SPLIT_CFG, s["split"], make_stage_mesh(2))
+    with pytest.raises(ValueError, match="raw_params"):
+        generate_split(rt2, rt2.place_params(s["params"]), s["ids"], MAX_NEW,
+                       recovery=RecoveryConfig(
+                           stage_failure=StageFailure(stage=1, at_step=1)))
+
+
+def test_stage_failure_replan_disabled_is_fatal(setup):
+    s = setup
+    rt2 = SplitRuntime(SPLIT_CFG, s["split"], make_stage_mesh(2))
+    with pytest.raises(StageLostError):
+        generate_split(rt2, rt2.place_params(s["params"]), s["ids"], MAX_NEW,
+                       recovery=RecoveryConfig(
+                           stage_failure=StageFailure(stage=1, at_step=1),
+                           replan=False),
+                       raw_params=s["params"])
+
+
+def test_split_config_replan():
+    sc = SplitConfig(cuts=(1, 3), hop_codecs=("int8_per_token", "fp32"))
+    re2 = sc.replan(num_layers=6, n_stages=2)
+    assert re2.cuts == (2,)
+    assert re2.hop_codecs == ("int8_per_token",)  # first hop's codec, uniform
+    assert sc.replan(6, 1).cuts == ()
+    assert sc.replan(6, 3, codec="fp32").hop_codecs == ("fp32", "fp32")
+    with pytest.raises(ValueError, match="re-plan"):
+        sc.replan(6, 7)
+    with pytest.raises(ValueError, match="explicit codec"):
+        SplitConfig(cuts=(), hop_codecs=()).replan(6, 3)
+
+
+# ---------------------------------------------------------------------------
+# zero-recovery config == exact pre-recovery graph
+# ---------------------------------------------------------------------------
+
+
+def test_zero_recovery_config_is_bit_identical(setup):
+    s = setup
+    out = generate_split(s["rt"], s["placed"], s["ids"], MAX_NEW,
+                         temperature=TEMP, rng_key=s["key"],
+                         recovery=RecoveryConfig())
+    assert np.array_equal(np.asarray(out), s["clean"])
+
+
+def test_local_generate_recovery_parity():
+    cfg = tiny_config("qwen2", num_layers=3, hidden_size=32, num_heads=4,
+                      vocab_size=128)
+    params = init_params(cfg, jax.random.key(2))
+    ids = _ids(cfg, 2, 10, seed=5)
+    key = jax.random.key(9)
+    ref = generate(cfg, params, ids, 5, temperature=0.5, rng_key=key)
+    out = generate(cfg, params, ids, 5, temperature=0.5, rng_key=key,
+                   recovery=RecoveryConfig())
+    assert np.array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_local_generate_halt_and_resume(tmp_path):
+    cfg = tiny_config("qwen2", num_layers=3, hidden_size=32, num_heads=4,
+                      vocab_size=128)
+    params = init_params(cfg, jax.random.key(2))
+    ids = _ids(cfg, 2, 10, seed=5)
+    key = jax.random.key(9)
+    ref = generate(cfg, params, ids, 6, temperature=0.5, rng_key=key)
+    ckpt = str(tmp_path / "local.ckpt")
+    generate(cfg, params, ids, 6, temperature=0.5, rng_key=key,
+             recovery=RecoveryConfig(checkpoint_path=ckpt, halt_at_step=2))
+    full = resume_split(LocalRuntime(cfg), params, ckpt)
+    assert np.array_equal(np.asarray(full), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_fires_deterministically():
+    # each passing check reads the clock twice: once for elapsed, once to
+    # re-arm
+    clock = iter([0.0, 1.0, 2.0, 3.0, 3.5, 100.0]).__next__
+    wd = Watchdog(5.0, clock=clock)
+    wd.arm()           # armed at t=0
+    wd.check()         # elapsed 1.0: within deadline, re-arms at t=2.0
+    wd.check()         # elapsed 1.0: fine, re-arms at t=3.5
+    with pytest.raises(DecodeTimeout, match="deadline"):
+        wd.check()     # elapsed 96.5: expired
+
+
+def test_watchdog_writes_best_effort_checkpoint():
+    clock = iter([0.0, 100.0]).__next__
+    wd = Watchdog(1.0, clock=clock)
+    wd.arm()
+    wrote = []
+    with pytest.raises(DecodeTimeout):
+        wd.check(lambda: wrote.append(1))
+    assert wrote == [1]
+    # a failing checkpoint sink must not mask the timeout
+    clock2 = iter([0.0, 100.0]).__next__
+    wd2 = Watchdog(1.0, clock=clock2)
+    wd2.arm()
+    with pytest.raises(DecodeTimeout):
+        wd2.check(lambda: 1 / 0)
+
+
+def test_decode_watchdog_fires_with_fake_clock(setup, tmp_path):
+    s = setup
+    tick = iter(range(0, 100000, 100))
+    ckpt = str(tmp_path / "wd.ckpt")
+    with pytest.raises(DecodeTimeout):
+        generate_split(s["rt"], s["placed"], s["ids"], MAX_NEW,
+                       temperature=TEMP, rng_key=s["key"],
+                       recovery=RecoveryConfig(
+                           checkpoint_path=ckpt, deadline_s=1.0,
+                           clock=lambda: float(next(tick))))
+    # the expiring check wrote a best-effort checkpoint we can resume from
+    full = resume_split(s["rt"], s["placed"], ckpt)
+    assert np.array_equal(np.asarray(full), s["clean"])
+
+
+# ---------------------------------------------------------------------------
+# eval harness threading
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def eval_setup():
+    from edgellm_tpu.eval.split_eval import run_split_eval
+
+    params = init_params(SPLIT_CFG, jax.random.key(1))
+    toks = np.asarray(_ids(SPLIT_CFG, 1, 400, seed=3)).reshape(-1)
+    base = run_split_eval(SPLIT_CFG, params, toks, cuts=[1, 3],
+                          hop_codecs=["fp32", "fp32"], max_length=64,
+                          stride=32, time_hops=False)
+    return dict(params=params, toks=toks, base=base)
+
+
+def test_eval_stage_failover_same_ppl(eval_setup):
+    from edgellm_tpu.eval.split_eval import run_split_eval
+
+    e = eval_setup
+    res = run_split_eval(SPLIT_CFG, e["params"], e["toks"], cuts=[1, 3],
+                         hop_codecs=["fp32", "fp32"], max_length=64,
+                         stride=32, time_hops=False,
+                         stage_failure={"stage": 2, "at_step": 2})
+    rec = res["recovery"]
+    assert rec["counters"]["failovers"] == 1
+    assert rec["counters"]["replans"] == 1
+    assert rec["replanned_cuts"] == [2]
+    assert rec["failover_mesh"]["stage"] == 2
+    assert res["chunks"] == e["base"]["chunks"]
+    # lossless hops: the boundary's position cannot change the PPL
+    assert res["ppl"] == pytest.approx(e["base"]["ppl"], abs=1e-9)
+    # post-failover wire traffic is accounted per plan generation
+    assert sum(rec["failover_hop_bytes_total"]["1"]) > 0
+
+
+def test_eval_zero_recovery_parity(eval_setup):
+    from edgellm_tpu.eval.split_eval import run_split_eval
+
+    e = eval_setup
+    res = run_split_eval(SPLIT_CFG, e["params"], e["toks"], cuts=[1, 3],
+                         hop_codecs=["fp32", "fp32"], max_length=64,
+                         stride=32, time_hops=False,
+                         recovery={"replan": True, "max_failovers": 1})
+    assert res["ppl"] == e["base"]["ppl"]
+    assert res["measured_hop_bytes_total"] == \
+        e["base"]["measured_hop_bytes_total"]
+
+
+def test_eval_watchdog_fires_with_fake_clock(eval_setup):
+    from edgellm_tpu.eval.split_eval import run_split_eval
+
+    e = eval_setup
+    tick = iter(range(0, 1000000, 100))
+    with pytest.raises(DecodeTimeout):
+        run_split_eval(SPLIT_CFG, e["params"], e["toks"], cuts=[1, 3],
+                       hop_codecs=["fp32", "fp32"], max_length=64, stride=32,
+                       time_hops=False, deadline_s=1.0,
+                       _clock=lambda: float(next(tick)))
+
+
+def test_eval_rejects_ring_stage_failure(eval_setup):
+    from edgellm_tpu.eval.split_eval import run_split_eval
+
+    e = eval_setup
+    with pytest.raises(ValueError, match="n_seq"):
+        run_split_eval(SPLIT_CFG, e["params"], e["toks"], cuts=[1],
+                       hop_codecs=["int8_per_token"], max_length=64,
+                       stride=32, n_seq=2,
+                       stage_failure={"stage": 1, "at_step": 0})
+
+
+# ---------------------------------------------------------------------------
+# params.json validation
+# ---------------------------------------------------------------------------
+
+
+def test_params_validation_accepts_failover_config():
+    import json
+
+    from edgellm_tpu.run import _validate_params_json
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    with open(os.path.join(root, "configs", "split6_qwen_failover.json")) as f:
+        _validate_params_json(json.load(f))  # must not raise
+
+
+@pytest.mark.parametrize("patch, msg", [
+    ({"deadline": -3}, "deadline"),
+    ({"deadline": True}, "deadline"),
+    ({"stage_failure": {"stage": 9, "at_step": 0}}, "out of range"),
+    ({"stage_failure": {"stageX": 1}}, "unknown field"),
+    ({"stage_failure": [1, 2]}, "stage_failure"),
+    ({"recovery": {"max_failovers": 0}}, "max_failovers"),
+    ({"recovery": {"replan": "yes"}}, "replan"),
+    ({"recovery": {"bogus": 1}}, "unknown field"),
+])
+def test_params_validation_rejects_bad_recovery(patch, msg):
+    from edgellm_tpu.run import _validate_params_json
+
+    p = {"experiment": "split", "cuts": [1, 3],
+         "hop_codecs": ["fp32", "fp32"], "max_length": 64, "stride": 32,
+         **patch}
+    with pytest.raises(SystemExit, match=msg):
+        _validate_params_json(p)
+
+
+def test_params_validation_recovery_keys_split_only():
+    from edgellm_tpu.run import _validate_params_json
+
+    with pytest.raises(SystemExit, match="only apply"):
+        _validate_params_json({"experiment": "initial",
+                               "layers_of_interest": [1], "ratios": [0.5],
+                               "deadline": 10.0})
