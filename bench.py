@@ -47,6 +47,11 @@ are visible. Knobs: BENCH_FAULT_RATES (comma floats, default "0,0.05,0.2"),
 BENCH_FAULT_KNOB (drop_rate|bitflip_rate|scale_corrupt_rate),
 BENCH_FAULT_RETRIES, BENCH_FAULT_CODEC, BENCH_FAULT_CHUNKS, BENCH_FAULT_SEED.
 
+BENCH_LINT=1 runs no workload: it pre-flights the build through the
+graphlint static-analysis gate (``python -m edgellm_tpu.lint``, REPRODUCING
+§8) and exits with its status — cheap insurance before a long accelerator
+reservation.
+
 BENCH_RECOVERY=1 switches to the survivable-decode workload (see
 ``recovery_main``): clean split decode tokens/s, checkpoint-and-resume
 latency (with the DecodeCheckpoint size), and end-to-end throughput across
@@ -460,6 +465,13 @@ def recovery_main():
 
 
 def main():
+    if os.environ.get("BENCH_LINT") == "1":
+        # pre-flight the bench build through graphlint (REPRODUCING §8):
+        # refuse to burn accelerator time on a build whose decode/split
+        # graphs violate their declared contracts
+        from edgellm_tpu.lint.__main__ import main as lint_main
+
+        raise SystemExit(lint_main(["--no-mypy"]))
     if os.environ.get("BENCH_RECOVERY") == "1":
         return recovery_main()
     if os.environ.get("BENCH_DECODE") == "1":

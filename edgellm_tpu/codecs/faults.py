@@ -36,11 +36,13 @@ assert it.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Any, Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from ..lint import graph_contract
 
 #: canary word sealed next to every payload; a dropped hop arrives all-zero
 #: and fails this check even when the zeroed payload's checksum is trivially 0
@@ -129,7 +131,7 @@ class LinkPolicy:
                 raise ValueError(f"{f} must be an integer >= {lo}, got {v!r}")
 
 
-def tree_nbytes(tree) -> int:
+def tree_nbytes(tree: Any) -> int:
     """Static byte size of a payload pytree (shapes/dtypes are trace-time
     constants, so the byte-budget comparison is a python bool under jit)."""
     return int(sum(int(np.prod(a.shape)) * a.dtype.itemsize
@@ -147,7 +149,7 @@ def _leaf_crc(leaf, salt: int):
     return jnp.sum(b.astype(jnp.uint32) * w, dtype=jnp.uint32)
 
 
-def payload_checksum(payload):
+def payload_checksum(payload: Any) -> jnp.ndarray:
     """uint32 checksum over every byte of every leaf; the per-leaf salt keys
     the positional weights so leaves can't trade bytes."""
     crc = jnp.uint32(0)
@@ -156,7 +158,7 @@ def payload_checksum(payload):
     return crc
 
 
-def seal_payload(payload) -> dict:
+def seal_payload(payload: Any) -> dict:
     """Wrap a codec payload with its integrity sidecar (8 bytes: canary +
     checksum) — the tree that actually crosses the wire under faults."""
     return {"canary": jnp.full((1,), CANARY, jnp.uint32),
@@ -164,14 +166,15 @@ def seal_payload(payload) -> dict:
             "p": payload}
 
 
-def verify_payload(sealed) -> jnp.ndarray:
+def verify_payload(sealed: dict) -> jnp.ndarray:
     """Scalar bool: the arrived payload is intact (canary alive AND checksum
     matches a fresh computation over the arrived bytes)."""
     return jnp.logical_and(sealed["canary"][0] == jnp.uint32(CANARY),
                            payload_checksum(sealed["p"]) == sealed["crc"][0])
 
 
-def inject_faults(sealed, key, cfg: FaultConfig):
+def inject_faults(sealed: dict, key: jax.Array,
+                  cfg: FaultConfig) -> dict:
     """Corrupt a sealed payload tree per ``cfg``, deterministically from
     ``key``. Bit flips and drops hit every leaf (sidecar included — a flipped
     checksum is a detected corruption too); scale corruption hits float
@@ -219,8 +222,19 @@ class FaultyLink:
     def init_counters(self, n_hops: int) -> dict:
         return {k: jnp.zeros((n_hops,), jnp.int32) for k in COUNTER_KEYS}
 
-    def hop(self, codec, hidden, s: int, axis_name: str, idx, key, counters,
-            hop_imp=None):
+    @graph_contract(
+        "faults.hop",
+        # per cut: every statically-unrolled attempt re-sends every sealed
+        # leaf (payload + canary + crc); the psum count is the structural
+        # output replication plus one per replicated counter. The lint driver
+        # traces a faulted split forward and supplies the measured ctx.
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"],
+                                 "psum": ctx["n_psum"]},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"])
+    def hop(self, codec: Any, hidden: jnp.ndarray, s: int, axis_name: str,
+            idx: jnp.ndarray, key: jax.Array, counters: dict,
+            hop_imp: Optional[jnp.ndarray] = None) -> tuple:
         """One faulty boundary crossing stage s -> s+1 (inside shard_map).
 
         Encode once; then up to 1+max_retries sealed transmissions, each with
@@ -312,7 +326,7 @@ class TierController:
         return self.tier
 
 
-def sum_counters(counter_list) -> Optional[dict]:
+def sum_counters(counter_list: Optional[Sequence[dict]]) -> Optional[dict]:
     """Host-side total of per-call counter dicts -> {key: (n_hops,) int64
     ndarray}. None/empty in, None out."""
     if not counter_list:

@@ -1,0 +1,22 @@
+"""graphlint: static analysis for the split-decode stack.
+
+Two layers behind one CLI (``python -m edgellm_tpu.lint``, REPRODUCING §8):
+
+- **AST rules** (:mod:`.ast_rules`): JAX footguns ruff can't see — traced
+  branches, host I/O under jit, numpy-on-tracer, missing static_argnames,
+  per-token host syncs in decode loops, trace-time container mutation.
+- **Graph contracts** (:mod:`.contracts` + :mod:`.entrypoints`): production
+  entry points declare their compiled-graph invariants with
+  :func:`graph_contract`; the lint CLI traces the real functions and
+  verifies collective counts, wire dtypes/bytes, no-f64, no-host-callback,
+  KV-cache donation, and disabled-config graph identity.
+
+This ``__init__`` stays import-light on purpose: production modules import
+:func:`graph_contract` from here at module import time, so pulling drivers
+or jax-heavy machinery in here would create cycles.
+"""
+from .contracts import GRAPH_CONTRACTS, GraphContract, graph_contract
+from .report import Finding, LintReport
+
+__all__ = ["GRAPH_CONTRACTS", "GraphContract", "graph_contract", "Finding",
+           "LintReport"]

@@ -1,0 +1,91 @@
+"""graphlint CLI: ``python -m edgellm_tpu.lint``.
+
+Exit 0 when every layer is clean, 1 when any finding survives. ``--json``
+writes the merged machine-readable report (the CI artifact).
+
+The graph layer traces real entry points over a 2-stage pipeline, so the
+spoofed multi-device CPU topology must be configured BEFORE jax initializes
+its backends — this module sets the env vars first and only then imports
+anything that pulls in jax (same bootstrap as tests/conftest.py).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _bootstrap_jax() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+
+    # sitecustomize may have imported jax already; backends are lazy, so
+    # forcing the platform here still lands before first device use
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m edgellm_tpu.lint",
+        description="graphlint: AST footgun rules + jaxpr-level graph "
+                    "contracts for the split-decode stack (REPRODUCING §8)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the merged JSON report here")
+    ap.add_argument("--ast-only", action="store_true",
+                    help="run only the AST rule layer (no jax import)")
+    ap.add_argument("--graph-only", action="store_true",
+                    help="run only the graph-contract layer")
+    ap.add_argument("--no-mypy", action="store_true",
+                    help="skip the scoped mypy --strict layer")
+    ap.add_argument("paths", nargs="*",
+                    help="AST-lint these files instead of the package "
+                         "(graph layer always targets the real package)")
+    args = ap.parse_args(argv)
+    if args.ast_only and args.graph_only:
+        ap.error("--ast-only and --graph-only are mutually exclusive")
+
+    from .report import LintReport, merge
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = os.path.dirname(pkg_root)
+    findings_by_layer = []
+    checked: list = []
+    skipped: list = []
+
+    if not args.graph_only:
+        from .ast_rules import iter_package_files, lint_paths
+
+        targets = args.paths or list(iter_package_files(pkg_root))
+        findings_by_layer.append(lint_paths(targets))
+
+        if not args.no_mypy and not args.paths:
+            from .typecheck import run_typecheck
+
+            ty_findings, ty_skips = run_typecheck(repo_root)
+            findings_by_layer.append(ty_findings)
+            skipped.extend(ty_skips)
+
+    if not args.ast_only:
+        _bootstrap_jax()
+        from .entrypoints import run_graph_checks
+
+        g_findings, g_checked, g_skips = run_graph_checks()
+        findings_by_layer.append(g_findings)
+        checked.extend(g_checked)
+        skipped.extend(g_skips)
+
+    report = LintReport(findings=merge(*findings_by_layer),
+                        checked_contracts=checked, skipped=skipped)
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            f.write(report.to_json() + "\n")
+    print(report.summary())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,86 @@
+"""Shared finding type + report serialization for both lint layers.
+
+Every rule — AST, graph contract, or typecheck — reports the same flat
+:class:`Finding` record, so the CLI can merge the layers into one JSON
+report and one exit code, and CI can archive a single artifact.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint violation.
+
+    layer: "ast" | "graph" | "typecheck".
+    rule: stable rule id (``EG00x`` for AST rules, ``GC-*`` for graph
+        contracts, ``MYPY`` for the typechecker).
+    where: file path (AST/typecheck) or contract name (graph layer).
+    line: 1-based source line, or 0 when the finding has no source anchor
+        (graph contracts point at traced jaxprs, not lines).
+    message: human-readable description of the violation.
+    """
+
+    layer: str
+    rule: str
+    where: str
+    line: int
+    message: str
+
+    def format(self) -> str:
+        anchor = f"{self.where}:{self.line}" if self.line else self.where
+        return f"[{self.rule}] {anchor}: {self.message}"
+
+
+@dataclasses.dataclass
+class LintReport:
+    """The merged result of every layer the CLI ran."""
+
+    findings: list  # list[Finding]
+    checked_contracts: list  # contract names that were verified clean
+    skipped: list  # layer-level skips, e.g. "typecheck: mypy not installed"
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "ok": self.ok,
+                "findings": [dataclasses.asdict(f) for f in self.findings],
+                "checked_contracts": list(self.checked_contracts),
+                "skipped": list(self.skipped),
+            },
+            indent=2, sort_keys=True)
+
+    def summary(self) -> str:
+        lines = [f.format() for f in self.findings]
+        lines.append(
+            f"graphlint: {len(self.findings)} violation(s), "
+            f"{len(self.checked_contracts)} graph contract(s) clean"
+            + (f", skipped: {'; '.join(self.skipped)}" if self.skipped else ""))
+        return "\n".join(lines)
+
+
+def sort_findings(findings: list) -> list:
+    return sorted(findings, key=lambda f: (f.layer, f.where, f.line, f.rule))
+
+
+def merge(*finding_lists: list) -> list:
+    out: list = []
+    for fl in finding_lists:
+        out.extend(fl)
+    return sort_findings(out)
+
+
+def load_report(path: str) -> Optional[dict]:
+    """Parse a previously-written JSON report (CI tooling convenience)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None

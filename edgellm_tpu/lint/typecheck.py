@@ -1,0 +1,63 @@
+"""Optional layer: scoped ``mypy --strict`` over the typed public surfaces.
+
+mypy is not a runtime dependency of the package — when it isn't importable
+(the pinned runtime image ships without it) the layer records a skip note
+instead of failing, and CI installs it so the gate actually runs there.
+Scope and strictness flags live in ``pyproject.toml`` (``[tool.mypy]``);
+this module only shells out and converts the output to findings.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import re
+import subprocess
+import sys
+from typing import List, Tuple
+
+from .report import Finding
+
+#: the modules whose public APIs carry full type hints (satellite: serve/,
+#: parallel/split.py, codecs/faults.py) — strictness is scoped here so the
+#: gate can be strict without annotating the whole package at once
+TYPED_MODULES = (
+    "edgellm_tpu/serve/decode.py",
+    "edgellm_tpu/serve/recovery.py",
+    "edgellm_tpu/parallel/split.py",
+    "edgellm_tpu/codecs/faults.py",
+)
+
+_LINE_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+):(?:\d+:)?\s*"
+                      r"error:\s*(?P<msg>.*)$")
+
+
+def mypy_available() -> bool:
+    return importlib.util.find_spec("mypy") is not None
+
+
+def run_typecheck(repo_root: str) -> Tuple[List[Finding], List[str]]:
+    """(findings, skip notes). Runs ``python -m mypy`` on TYPED_MODULES with
+    the pyproject config; absent mypy degrades to a recorded skip."""
+    if not mypy_available():
+        return [], ["typecheck: mypy not installed (pip install mypy to "
+                    "enable; CI runs it)"]
+    targets = [os.path.join(repo_root, m) for m in TYPED_MODULES]
+    missing = [t for t in targets if not os.path.exists(t)]
+    if missing:
+        return [], [f"typecheck: missing targets {missing}"]
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--no-error-summary", *targets],
+        cwd=repo_root, capture_output=True, text=True, timeout=600)
+    findings: List[Finding] = []
+    for line in proc.stdout.splitlines():
+        m = _LINE_RE.match(line.strip())
+        if m:
+            findings.append(Finding(
+                layer="typecheck", rule="MYPY", where=m.group("path"),
+                line=int(m.group("line")), message=m.group("msg")))
+    if proc.returncode not in (0, 1):  # 1 = type errors; anything else broke
+        findings.append(Finding(
+            layer="typecheck", rule="MYPY", where="mypy", line=0,
+            message=f"mypy crashed (exit {proc.returncode}): "
+                    f"{proc.stderr.strip()[:500]}"))
+    return findings, []

@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from .configs import ModelConfig
+from ..lint import graph_contract
 
 
 class AttnStats(NamedTuple):
@@ -483,6 +484,7 @@ def cache_from_state_dict(state: dict) -> dict:
             "length": jnp.asarray(state["length"], jnp.int32)}
 
 
+@graph_contract("transformer.prefill", collectives={})
 def prefill(cfg: ModelConfig, params: dict, input_ids: jnp.ndarray,
             capacity: int, *,
             boundary_fn: Optional[Callable] = None,
@@ -563,6 +565,7 @@ def block_decode(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray,
     return hidden + mlp(cfg, lp, mlp_in, tp_axis), k_cache, v_cache
 
 
+@graph_contract("transformer.decode_step", collectives={})
 def decode_step(cfg: ModelConfig, params: dict, cache: KVCache,
                 token_ids: jnp.ndarray, *,
                 boundary_fn: Optional[Callable] = None,

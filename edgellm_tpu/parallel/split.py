@@ -25,6 +25,7 @@ code with two cuts.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 from typing import Optional, Sequence
 
@@ -38,6 +39,7 @@ from ..models.transformer import (block, block_decode, embed, unembed,
                                   precompute_rope, KVCache)
 from ..codecs.packing import get_wire_codec, WireCodec
 from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
+from ..lint import graph_contract
 from ..serve.recovery import StageLostError
 from ..utils.jax_compat import shard_map, pcast_varying
 
@@ -489,6 +491,13 @@ class SplitRuntime:
 
         return fn
 
+    @graph_contract(
+        "split.forward",
+        # one ppermute per payload leaf per cut, one structural psum; the
+        # driver supplies the measured counts/bytes from the codec registry
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"])
     def forward(self, placed_params: dict, input_ids: jnp.ndarray,
                 hop_importance: Optional[Sequence] = None,
                 fault_step: int = 0) -> jnp.ndarray:
@@ -680,7 +689,10 @@ class SplitRuntime:
               fault_step)
             return unembed(cfg, placed, out), kc, vc, counters
 
-        @jax.jit
+        # per-stage KV buffers are donated: each emitted token updates the
+        # (n_stages, sz, B, capacity) caches in place instead of copying them
+        # (the "split.decode_step" contract asserts the aliasing survives)
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
         def step_fn(placed, k_cache, v_cache, length, token_ids):
             hidden = embed(placed, token_ids[:, None])  # (B, 1, D)
             cos, sin = precompute_rope(cfg, capacity)
@@ -712,7 +724,7 @@ class SplitRuntime:
         return self._decode_fns_cache[capacity]
 
     def prefill_decode(self, placed_params: dict, input_ids: jnp.ndarray,
-                       capacity: int, fault_step: int = 0):
+                       capacity: int, fault_step: int = 0) -> tuple:
         """Pipeline-split prefill that also fills the per-stage KV caches.
         Returns (logits (B, S, V) fp32, cache dict) — feed the cache to
         :meth:`decode_step`. Cache k/v: (n_stages, sz, B, capacity, KV, hd),
@@ -732,8 +744,14 @@ class SplitRuntime:
             self._counter_accum.append(counters)
         return logits, {"k": kc, "v": vc, "length": jnp.asarray(s, jnp.int32)}
 
+    @graph_contract(
+        "split.decode_step",
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"],
+        donate=lambda ctx: ctx.get("donate_min", 2))
     def decode_step(self, placed_params: dict, cache: dict,
-                    token_ids: jnp.ndarray):
+                    token_ids: jnp.ndarray) -> tuple:
         """One decode position across the pipeline: each cut quantizes the
         single-token hidden state through its wire codec (under faults, via
         the sealed/verified link, keyed by the cache fill level). Returns

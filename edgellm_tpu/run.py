@@ -245,6 +245,13 @@ def _validate_params_json(p: dict) -> None:
 
 
 def main(argv=None) -> int:
+    # --lint short-circuits before the parser: the graphlint gate needs no
+    # params.json, and running it first means a contract violation is caught
+    # before any experiment spends accelerator time (REPRODUCING §8)
+    if "--lint" in (sys.argv[1:] if argv is None else argv):
+        from .lint.__main__ import main as lint_main
+
+        return lint_main(["--no-mypy"])
     ap = argparse.ArgumentParser(description=__doc__,
                                  formatter_class=argparse.RawDescriptionHelpFormatter)
     ap.add_argument("--params", required=True, help="reference-style params.json")
@@ -281,6 +288,10 @@ def main(argv=None) -> int:
                          "before touching devices; split meshes become "
                          "slice-aware (stage/seq/model axes pinned within a "
                          "slice, only the data axis crosses DCN)")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the graphlint static-analysis gate (AST rules "
+                         "+ jaxpr contracts, python -m edgellm_tpu.lint) and "
+                         "exit — handled before any other flag is required")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--synthetic-corpus-len", type=int, default=4096)
     args = ap.parse_args(argv)

@@ -32,34 +32,44 @@ recovery is host-side orchestration, never a different graph.
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.typing import ArrayLike
 
+from ..lint import graph_contract
 from ..models.configs import ModelConfig
-from ..models.transformer import (cache_from_state_dict, cache_state_dict,
-                                  decode_step, prefill)
+from ..models.transformer import (KVCache, cache_from_state_dict,
+                                  cache_state_dict, decode_step, prefill)
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        LocalRuntime, RecoveryConfig, RecoveryCounters,
                        StageLostError, Watchdog, runtime_plan_meta)
 
 
-def _sample(logits, key, temperature: float):
+def _sample(logits: jnp.ndarray, key: jax.Array,
+            temperature: float) -> jnp.ndarray:
     """(B, V) fp32 logits -> (B,) int32 token ids."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return jax.random.categorical(key, logits / temperature).astype(jnp.int32)
 
 
-def _prefill_impl(cfg, params, prompt_ids, capacity, compute_dtype):
+@graph_contract("decode.prefill", collectives={})
+def _prefill_impl(cfg: ModelConfig, params: dict, prompt_ids: jnp.ndarray,
+                  capacity: int,
+                  compute_dtype: Optional[Any]) -> tuple[jnp.ndarray, KVCache]:
     logits, cache = prefill(cfg, params, prompt_ids, capacity,
                             compute_dtype=compute_dtype)
     return logits[:, -1], cache  # only the last position seeds generation
 
 
-def _step_impl(cfg, params, cache, token_ids, key, temperature, compute_dtype):
+@graph_contract("decode.step", collectives={},
+                donate=lambda ctx: ctx.get("donate_min", 2))
+def _step_impl(cfg: ModelConfig, params: dict, cache: KVCache,
+               token_ids: jnp.ndarray, key: jax.Array, temperature: float,
+               compute_dtype: Optional[Any]) -> tuple[jnp.ndarray, KVCache]:
     logits, cache = decode_step(cfg, params, cache, token_ids,
                                 compute_dtype=compute_dtype)
     return _sample(logits, key, temperature), cache
@@ -67,8 +77,12 @@ def _step_impl(cfg, params, cache, token_ids, key, temperature, compute_dtype):
 
 _prefill_jit = jax.jit(_prefill_impl,
                        static_argnames=("cfg", "capacity", "compute_dtype"))
+# the cache is donated: each step's (B, capacity) KV buffers alias the previous
+# step's in the lowered executable instead of being copied per token (the
+# "decode.step" graph contract asserts the aliasing survives)
 _step_jit = jax.jit(_step_impl,
-                    static_argnames=("cfg", "temperature", "compute_dtype"))
+                    static_argnames=("cfg", "temperature", "compute_dtype"),
+                    donate_argnames=("cache",))
 
 
 def decode_step_cache_size() -> int:
@@ -97,7 +111,8 @@ def _validate_decode_args(prompt_ids, max_new_tokens, capacity, temperature,
     return prompt_ids, capacity, temperature, key
 
 
-def generate(cfg: ModelConfig, params: dict, prompt_ids, max_new_tokens: int,
+def generate(cfg: ModelConfig, params: dict, prompt_ids: ArrayLike,
+             max_new_tokens: int,
              *,
              capacity: Optional[int] = None,
              temperature: float = 0.0,
@@ -159,7 +174,8 @@ def generate(cfg: ModelConfig, params: dict, prompt_ids, max_new_tokens: int,
     return out
 
 
-def generate_split(rt, placed_params: dict, prompt_ids, max_new_tokens: int,
+def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
+                   max_new_tokens: int,
                    *,
                    capacity: Optional[int] = None,
                    temperature: float = 0.0,
@@ -439,7 +455,7 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
     return out
 
 
-def resume_split(rt, placed_params: dict, checkpoint_path: str, *,
+def resume_split(rt: Any, placed_params: dict, checkpoint_path: str, *,
                  stats: Optional[dict] = None,
                  recovery: Optional[RecoveryConfig] = None,
                  raw_params: Optional[dict] = None) -> jnp.ndarray:

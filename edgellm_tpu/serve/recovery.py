@@ -39,7 +39,7 @@ import os
 import struct
 import time
 import zlib
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 import jax
@@ -317,7 +317,7 @@ class DecodeCheckpoint:
         return cls(arrays, meta)
 
 
-def runtime_plan_meta(rt) -> dict:
+def runtime_plan_meta(rt: Any) -> dict:
     """The plan/model signature a checkpoint records and resume validates:
     enough to refuse resuming split state onto a different cut layout or a
     different model. Duck-typed — any runtime with ``cfg`` (and, for split
@@ -347,8 +347,11 @@ def _local_prefill(cfg, params, input_ids, capacity, compute_dtype):
                    compute_dtype=compute_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "compute_dtype"))
+@functools.partial(jax.jit, static_argnames=("cfg", "compute_dtype"),
+                   donate_argnames=("cache",))
 def _local_step(cfg, params, cache, token_ids, compute_dtype):
+    # cache donated: the failover runtime updates its KV buffers in place,
+    # same as the split step executable (graph contract "decode.step")
     return decode_step(cfg, params, cache, token_ids,
                        compute_dtype=compute_dtype)
 
@@ -372,13 +375,14 @@ class LocalRuntime:
     def place_params(self, params: dict) -> dict:
         return params  # single device: nothing to shard
 
-    def prefill_decode(self, params: dict, input_ids, capacity: int,
-                       fault_step: int = 0):
+    def prefill_decode(self, params: dict, input_ids: jnp.ndarray,
+                       capacity: int, fault_step: int = 0) -> tuple:
         logits, kv = _local_prefill(self.cfg, params, input_ids,
                                     int(capacity), self.compute_dtype)
         return logits, {"k": kv.k, "v": kv.v, "length": kv.length}
 
-    def decode_step(self, params: dict, cache: dict, token_ids):
+    def decode_step(self, params: dict, cache: dict,
+                    token_ids: jnp.ndarray) -> tuple:
         logits, kv = _local_step(
             self.cfg, params,
             KVCache(cache["k"], cache["v"], cache["length"]), token_ids,
@@ -390,7 +394,7 @@ class LocalRuntime:
             "LocalRuntime runs on a single device — there is no pipeline "
             "stage to lose; stage_failure injection needs a split runtime")
 
-    def link_counters(self, reset: bool = False):
+    def link_counters(self, reset: bool = False) -> Optional[dict]:
         return None
 
     def decode_hop_bytes(self, batch: int) -> list:

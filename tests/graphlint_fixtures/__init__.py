@@ -1,0 +1,3 @@
+# Deliberately-broken modules for tests/test_graphlint.py. They are parsed
+# by the AST lint layer, NEVER imported — each bad_eg00x.py seeds exactly the
+# footgun its rule exists to catch.

@@ -1,0 +1,23 @@
+"""EG001 seed: Python control flow on traced values inside jitted code."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def branch_on_traced(x):
+    if jnp.any(x > 0):  # line 8: traced branch
+        return x + 1
+    return x
+
+
+@jax.jit
+def loop_on_traced(x):
+    while x.any():  # line 15: traced while
+        x = x - 1
+    return x
+
+
+@jax.jit
+def assert_on_traced(x):
+    assert jnp.all(x > 0)  # line 22: trace-time assert
+    return x

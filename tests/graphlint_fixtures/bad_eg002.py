@@ -1,0 +1,15 @@
+"""EG002 seed: host I/O reachable from a jitted function."""
+import time
+
+import jax
+
+
+def helper(x):
+    t0 = time.time()  # line 9: trace-time clock read
+    print("tracing", t0)  # line 10: trace-time print
+    return x
+
+
+@jax.jit
+def jitted(x):
+    return helper(x) * 2

@@ -1,0 +1,8 @@
+"""EG003 seed: numpy math applied to a traced array under jit."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def numpy_on_tracer(x):
+    return np.sqrt(x)  # line 8: host numpy on a tracer

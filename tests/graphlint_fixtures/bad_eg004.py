@@ -1,0 +1,16 @@
+"""EG004 seed: jax.jit over config-like params without static_argnames."""
+from functools import partial
+
+import jax
+
+
+def run(cfg, x):
+    return x * cfg.scale
+
+
+run_jit = jax.jit(run)  # line 11: cfg not static
+
+
+@partial(jax.jit, static_argnames=("unrelated",))
+def stepper(cfg, capacity, x, unrelated=None):  # line 15: cfg/capacity missing
+    return x[:capacity] * cfg.scale

@@ -1,0 +1,11 @@
+"""EG005 seed: per-token host syncs inside a decode/generate loop."""
+
+
+def generate(model, steps):
+    toks = []
+    tok = 0
+    for _ in range(steps):
+        logits = model(tok)
+        tok = int(logits.argmax())  # line 9: host coercion per token
+        toks.append(logits.item())  # line 10: device sync per token
+    return toks

@@ -1,0 +1,15 @@
+"""EG006 seed: trace-time mutation of captured containers."""
+import jax
+
+
+@jax.jit
+def outer(x):
+    acc = []
+    seen = {}
+
+    def inner(y):
+        acc.append(y)  # line 11: captured list mutated under trace
+        seen["y"] = y  # line 12: captured dict written under trace
+        return y
+
+    return inner(x)

@@ -1,0 +1,35 @@
+"""Clean module: every rule's legitimate counterpart — must lint clean."""
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("cfg", "capacity"))
+def run(cfg, capacity, x):
+    # static python branch is fine (cfg is static, the bool is concrete)
+    if capacity > 4:
+        x = x[:, :capacity]
+    return jnp.where(x > 0, x, 0.0) * cfg if cfg else x
+
+
+def host_driver(steps):
+    # host code may print, time, and use numpy freely
+    t0 = time.time()
+    sizes = np.asarray([1, 2, 3])
+    print("driver", t0, int(np.prod(sizes)))
+    out = []
+    for s in range(steps):
+        out.append(s)  # mutation in plain host code is fine
+    return out
+
+
+def generate(model, steps):
+    toks = []
+    tok = jnp.zeros((1,), jnp.int32)
+    for _ in range(steps):
+        tok = model(tok)
+        toks.append(tok)  # stays on device; one sync after the loop
+    return jnp.stack(toks)
