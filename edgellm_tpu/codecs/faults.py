@@ -59,7 +59,10 @@ _CRC_MULT = 2654435761
 #: integrity check, retried = re-sends actually needed, recovered = hops that
 #: failed at least once but eventually verified, substituted = hops that
 #: exhausted retries and fell back per the policy, budget_dropped = hops whose
-#: packed payload statically exceeded the byte budget
+#: packed payload statically exceeded the byte budget. A self-healing link
+#: (:mod:`~edgellm_tpu.codecs.fec`) appends "repaired" (corrupted arrivals
+#: healed in band by XOR parity) and "hedge_wins" (hops a non-primary
+#: staggered route delivered first) via :attr:`FaultyLink.counter_keys`.
 COUNTER_KEYS = ("hops", "detected", "retried", "recovered", "substituted",
                 "budget_dropped")
 
@@ -214,13 +217,35 @@ def _bump(counters: dict, key: str, hop: int, cond) -> dict:
 @dataclasses.dataclass(frozen=True)
 class FaultyLink:
     """The hop protocol under faults — a static closure the pipeline unroll
-    calls in place of the bare encode/ppermute/decode when faults are on."""
+    calls in place of the bare encode/ppermute/decode when faults are on.
+
+    ``fec`` (a :class:`~edgellm_tpu.codecs.fec.FECConfig`) and ``hedge``
+    (a :class:`~edgellm_tpu.codecs.fec.HedgeConfig`) arm the self-healing
+    ladder — in-band XOR-parity repair and staggered redundant routes; with
+    both absent or disabled, :meth:`hop` is the exact PR 2 protocol and the
+    traced graph is bit-identical to a pre-FEC build."""
 
     faults: FaultConfig
     policy: LinkPolicy
+    fec: Optional[Any] = None
+    hedge: Optional[Any] = None
+
+    @property
+    def healing(self) -> bool:
+        return ((self.fec is not None and self.fec.enabled)
+                or (self.hedge is not None and self.hedge.enabled))
+
+    @property
+    def counter_keys(self) -> tuple:
+        keys = COUNTER_KEYS
+        if self.fec is not None and self.fec.enabled:
+            keys = keys + ("repaired",)
+        if self.hedge is not None and self.hedge.enabled:
+            keys = keys + ("hedge_wins",)
+        return keys
 
     def init_counters(self, n_hops: int) -> dict:
-        return {k: jnp.zeros((n_hops,), jnp.int32) for k in COUNTER_KEYS}
+        return {k: jnp.zeros((n_hops,), jnp.int32) for k in self.counter_keys}
 
     @graph_contract(
         "faults.hop",
@@ -242,6 +267,11 @@ class FaultyLink:
         unroll); the receiver's verify gates which attempt's decode is kept,
         and counters accumulate receiver-side only so the later psum counts
         each hop exactly once. Returns (new hidden, counters)."""
+        if self.healing:
+            from .fec import healing_hop
+
+            return healing_hop(self, codec, hidden, s, axis_name, idx, key,
+                               counters, hop_imp)
         if codec.needs_importance:
             payload = codec.encode(hidden, hop_imp)
         else:

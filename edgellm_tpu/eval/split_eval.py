@@ -34,6 +34,7 @@ from ..importance import importance_per_layer
 from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
 from ..codecs.packing import WireCodec, get_wire_codec, selective_int4
 from ..codecs.faults import FaultConfig, LinkPolicy, TierController, sum_counters
+from ..codecs.fec import FECConfig, HedgeConfig, LinkHealth, LinkHealthConfig
 from ..serve.recovery import (DecodeTimeout, RecoveryCounters, StageFailure,
                               StageLostError, Watchdog)
 from .harness import (ResumableDriver, _emit, _iter_window_groups,
@@ -115,6 +116,9 @@ def run_split_eval(
     metrics_path: Optional[str] = None,
     faults: Optional[object] = None,
     link_policy: Optional[object] = None,
+    fec: Optional[object] = None,
+    hedge: Optional[object] = None,
+    link_health: Optional[object] = None,
     deadline_s: Optional[float] = None,
     stage_failure: Optional[object] = None,
     recovery: Optional[dict] = None,
@@ -158,6 +162,21 @@ def run_split_eval(
     result. Robustness state is per-run: a resumed run restarts counters and
     the tier ladder at tier 0 (the checkpointed PPL partial sums stay exact).
 
+    Self-healing (PR 5): ``fec`` (:class:`~edgellm_tpu.codecs.fec.FECConfig`
+    or kwargs dict) adds interleaved XOR parity to every sealed hop so a
+    single corrupted chunk per parity group is repaired in band — zero extra
+    hops; ``hedge`` (:class:`~edgellm_tpu.codecs.fec.HedgeConfig` or dict)
+    sends each attempt over staggered redundant routes and keeps the first
+    verified copy (for drop-dominated links, where parity can't help).
+    ``link_health`` (:class:`~edgellm_tpu.codecs.fec.LinkHealthConfig` or
+    dict) replaces the streak-based TierController with the SLO tracker:
+    windowed corruption/repair/retry/hedge-win rates from the per-chunk
+    counter deltas, burn-rate-driven degradation AND re-promotion over
+    ``link_policy.tiers``, with a full-window re-measure plus ``min_dwell_s``
+    of clock hysteresis between switches. All three require an enabled
+    ``faults`` config (the link machinery otherwise never enters the graph);
+    disabled configs build the exact PR 2/3 graph.
+
     Survivability (PR 3): ``deadline_s`` arms a host-side monotonic
     :class:`~edgellm_tpu.serve.recovery.Watchdog` that is petted after every
     drained chunk — a stalled eval writes a best-effort resume checkpoint and
@@ -184,6 +203,19 @@ def run_split_eval(
             tiers=tuple(link_policy.get("tiers", ())))
     fault_on = faults is not None and faults.enabled
     policy = link_policy if link_policy is not None else LinkPolicy()
+    if isinstance(fec, dict):
+        fec = FECConfig(**fec)
+    if isinstance(hedge, dict):
+        hedge = HedgeConfig(**hedge)
+    if isinstance(link_health, dict):
+        link_health = LinkHealthConfig(**link_health)
+    healing_requested = ((fec is not None and fec.enabled)
+                         or (hedge is not None and hedge.enabled)
+                         or link_health is not None)
+    if healing_requested and not fault_on:
+        raise ValueError(
+            "fec/hedge/link_health require an enabled faults config — the "
+            "link machinery only exists in the graph when a fault can fire")
     if isinstance(stage_failure, dict):
         stage_failure = StageFailure(**stage_failure)
     if stage_failure is not None and n_seq > 1:
@@ -218,20 +250,28 @@ def run_split_eval(
             from ..parallel.ring import SplitRingRuntime
 
             return SplitRingRuntime(cfg, split.cuts, list(tier_codecs), mesh,
-                                    faults=faults, policy=link_policy)
+                                    faults=faults, policy=link_policy,
+                                    fec=fec, hedge=hedge)
         return SplitRuntime(
             cfg, SplitConfig(cuts=split.cuts, hop_codecs=tuple(tier_codecs)),
-            mesh, faults=faults, policy=link_policy)
+            mesh, faults=faults, policy=link_policy, fec=fec, hedge=hedge)
 
     # tier 0 is the configured codec set; lower tiers swap EVERY hop to one
     # uniform fallback codec (payload shapes change, hence separate runtimes
     # — parameter placement is codec-independent, so ``placed`` is shared)
     ladder = [list(codecs)]
     controller = None
+    health = None
     if fault_on and policy.tiers:
         for name in policy.tiers:
             get_wire_codec(name)  # fail fast on a bad ladder entry
             ladder.append([name] * len(codecs))
+    if link_health is not None:
+        # the SLO tracker supersedes the streak controller: burn-rate-driven
+        # degradation AND re-promotion, clock-hysteresis via the injectable
+        # eval clock (so tests can fake it)
+        health = LinkHealth(len(ladder), link_health, clock=_clock)
+    elif fault_on and policy.tiers:
         controller = TierController(len(ladder), policy.degrade_after,
                                     policy.recover_after)
     runtimes = {0: _make_runtime(ladder[0])}
@@ -280,6 +320,12 @@ def run_split_eval(
         axes["faults"] = dataclasses.asdict(faults)
         axes["link_policy"] = {**dataclasses.asdict(policy),
                                "tiers": list(policy.tiers)}
+        if fec is not None:
+            axes["fec"] = dataclasses.asdict(fec)
+        if hedge is not None:
+            axes["hedge"] = dataclasses.asdict(hedge)
+        if link_health is not None:
+            axes["link_health"] = dataclasses.asdict(link_health)
     if stage_failure is not None:
         axes["stage_failure"] = dataclasses.asdict(stage_failure)
     rd = ResumableDriver(checkpoint_path, axes, checkpoint_every)
@@ -322,7 +368,7 @@ def run_split_eval(
         split = split.replan(cfg.num_layers, survivors.shape[0])
         rcounters.replans += 1
         ladder = [list(split.hop_codecs)]
-        if controller is not None:
+        if controller is not None or health is not None:
             for name in policy.tiers:
                 ladder.append([name] * len(split.hop_codecs))
         runtimes.clear()
@@ -354,7 +400,10 @@ def run_split_eval(
             sf_pending = False
             for r in runtimes.values():
                 r.mark_stage_lost(stage_failure.stage)
-        tier = controller.tier if controller is not None else 0
+        if health is not None:
+            tier = health.tier
+        else:
+            tier = controller.tier if controller is not None else 0
         # the chunk index drives the fault stream: same seed => same chunks
         # corrupted, run after run (ignored when the link is off)
         fstep = group[0].index
@@ -405,7 +454,11 @@ def run_split_eval(
             gen_bytes[g][i] += b
         if tier:
             degraded_chunks += 1
-        if controller is not None:
+        if health is not None:
+            prev = health.tier
+            if health.observe(chunk_counters) != prev:
+                tier_log.append((group[-1].index, health.tier))
+        elif controller is not None:
             corrupted = any(
                 int(np.asarray(chunk_counters[k]).sum())
                 for k in ("detected", "budget_dropped"))
@@ -423,6 +476,8 @@ def run_split_eval(
                 "hop_bytes_total": hop_bytes_total}
             if fault_on:
                 rec_out["tier"] = tier
+            if health is not None:
+                rec_out["burn_rate"] = health.burn_rate
             _emit(metrics_path, rec_out)
         if wd is not None:
             # pet-the-dog once per drained chunk; a stall past the deadline
@@ -487,8 +542,16 @@ def run_split_eval(
         result["tier_ladder"] = [[c if isinstance(c, str) else c.name
                                   for c in t] for t in ladder]
         result["tier_switches"] = [list(t) for t in tier_log]
-        result["final_tier"] = controller.tier if controller is not None else 0
+        result["final_tier"] = (health.tier if health is not None
+                                else controller.tier
+                                if controller is not None else 0)
         result["degraded_chunks"] = degraded_chunks
+        if fec is not None:
+            result["fec"] = dataclasses.asdict(fec)
+        if hedge is not None:
+            result["hedge"] = dataclasses.asdict(hedge)
+        if health is not None:
+            result["link_health"] = health.summary()
     if recovery_on:
         rec_block = {
             "deadline_s": deadline_s,
@@ -517,6 +580,8 @@ def run_split_eval(
     if fault_on:
         final_rec["link_counters"] = result["link_counters"]
         final_rec["degraded_chunks"] = degraded_chunks
+        if health is not None:
+            final_rec["burn_rate"] = health.burn_rate
     if recovery_on:
         final_rec["failovers"] = rcounters.failovers
     _emit(metrics_path, final_rec)
@@ -542,7 +607,9 @@ def run_fault_sweep(
     fresh :class:`FaultConfig` each time. Rate 0 with no ``byte_budget`` runs
     the plain fault-free graph — the sweep's exact baseline point. Each result
     dict gains ``fault_knob`` / ``fault_rate``; remaining kwargs pass through
-    (cuts, hop_codecs, max_length, stride, ...).
+    (cuts, hop_codecs, max_length, stride, ...). Healing kwargs
+    (``fec``/``hedge``/``link_health``) are withheld from fault-free points —
+    the clean graph has no link to heal, so the baseline stays exact.
     """
     if knob not in ("drop_rate", "bitflip_rate", "scale_corrupt_rate"):
         raise ValueError(f"unknown fault knob {knob!r}")
@@ -550,9 +617,13 @@ def run_fault_sweep(
     for r in rates:
         fc = FaultConfig(**{knob: float(r)}, byte_budget=byte_budget,
                          seed=seed)
+        kw = eval_kwargs
+        if not fc.enabled:
+            kw = {k: v for k, v in eval_kwargs.items()
+                  if k not in ("fec", "hedge", "link_health")}
         res = run_split_eval(cfg, params, token_ids,
                              faults=fc if fc.enabled else None,
-                             link_policy=link_policy, **eval_kwargs)
+                             link_policy=link_policy, **kw)
         res["fault_knob"] = knob
         res["fault_rate"] = float(r)
         out.append(res)

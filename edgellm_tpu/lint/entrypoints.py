@@ -192,6 +192,43 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     run_one("faults.hop", rt_fault._forward,
             (placed, ids, imps, fault_step), fault_ctx)
 
+    # ---- self-healing link: FEC parity + hedged routes ------------------
+    from ..codecs.fec import FECConfig, HedgeConfig
+
+    fec_cfg = FECConfig(group_size=4, n_groups=4)
+    hedge_cfg = HedgeConfig(routes=2)
+    rt_fec = SplitRuntime(cfg, split, mesh,
+                          faults=FaultConfig(bitflip_rate=0.01, seed=0),
+                          policy=LinkPolicy(max_retries=attempts - 1),
+                          fec=fec_cfg, hedge=hedge_cfg)
+    transmissions = attempts * hedge_cfg.routes  # retries x staggered routes
+    fec_ctx = {
+        # 2 wire leaves per transmission: the chunk matrix + the word vector
+        "hop_eqns": n_hops * 2 * transmissions,
+        "n_psum": 1 + len(rt_fec._link.counter_keys),
+        "wire_dtypes": frozenset({"uint8", "uint32"}),
+        # ppermute traffic = declared payload + parity overhead, per route
+        "wire_bytes": transmissions * fec_cfg.wire_nbytes(bytes_f + 8)
+        * n_hops,
+    }
+    run_one("fec.hop", rt_fec._forward,
+            (placed, ids, imps, fault_step), fec_ctx)
+
+    # a faulted build with FEC and hedging *disabled* must trace the exact
+    # PR 2 hop — same fingerprint as a build that never heard of fec.py
+    rt_fec_off = SplitRuntime(cfg, split, mesh,
+                              faults=FaultConfig(bitflip_rate=0.01, seed=0),
+                              policy=LinkPolicy(max_retries=attempts - 1),
+                              fec=FECConfig(enabled=False),
+                              hedge=HedgeConfig(enabled=False))
+    ident = check_identity(
+        "split.forward.fec-disabled-identity",
+        rt_fault._forward, (placed, ids, imps, fault_step),
+        rt_fec_off._forward, (placed, ids, imps, fault_step),
+        what="disabled-FEC faulted forward graph")
+    (findings.extend(ident) if ident
+     else checked.append("split.forward.fec-disabled-identity"))
+
     # ---- disabled-config identity: a zero-rate fault config and an absent
     # ---- one must compile the SAME executable -----------------------------
     rt_zero = SplitRuntime(cfg, split, mesh, faults=FaultConfig())

@@ -316,7 +316,7 @@ class SplitRingRuntime:
     """
 
     def __init__(self, cfg: ModelConfig, cuts, hop_codecs, mesh: Mesh,
-                 faults=None, policy=None):
+                 faults=None, policy=None, fec=None, hedge=None):
         from .split import SplitConfig, apply_default_codec_backend
         from ..codecs.ring_codecs import RingWireCodec
         from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy
@@ -325,9 +325,11 @@ class SplitRingRuntime:
         self.mesh = mesh
         self.faults = faults
         self.policy = policy if policy is not None else LinkPolicy()
+        self.fec = fec
+        self.hedge = hedge
         # same activation rule as SplitRuntime: zero rates build the exact
-        # fault-free graph
-        self._link = (FaultyLink(faults, self.policy)
+        # fault-free graph (a disabled FEC/hedge config traces the PR 2 hop)
+        self._link = (FaultyLink(faults, self.policy, fec=fec, hedge=hedge)
                       if faults is not None and faults.enabled else None)
         self._counter_accum: list = []
         self._lost_stage = None

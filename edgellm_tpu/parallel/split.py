@@ -27,7 +27,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import os
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import numpy as np
 import jax
@@ -304,15 +304,20 @@ class SplitRuntime:
 
     def __init__(self, cfg: ModelConfig, split: SplitConfig, mesh: Mesh,
                  faults: Optional[FaultConfig] = None,
-                 policy: Optional[LinkPolicy] = None):
+                 policy: Optional[LinkPolicy] = None,
+                 fec: Optional[Any] = None,
+                 hedge: Optional[Any] = None):
         self.cfg = cfg
         self.split = split
         self.mesh = mesh
         self.faults = faults
         self.policy = policy if policy is not None else LinkPolicy()
+        self.fec = fec
+        self.hedge = hedge
         # an all-zero-rate config builds the exact fault-free graph: the link
         # machinery only exists in the jaxpr when a fault can actually fire
-        self._link = (FaultyLink(faults, self.policy)
+        # (and a disabled FEC/hedge config traces the exact PR 2 hop)
+        self._link = (FaultyLink(faults, self.policy, fec=fec, hedge=hedge)
                       if faults is not None and faults.enabled else None)
         self._counter_accum: list = []
         self._lost_stage: Optional[int] = None

@@ -105,6 +105,7 @@ _PARAM_KEYS = {
     "cuts": "split", "hop_codecs": "split", "importance_method": "split",
     "n_seq": "split", "n_data": "split", "n_model": "split",
     "faults": "split", "link_policy": "split",
+    "fec": "split", "hedge": "split", "link_health": "split",
     "deadline": "split", "stage_failure": "split", "recovery": "split",
     "max_compiles": "distances",
 }
@@ -132,10 +133,12 @@ def _validate_params_json(p: dict) -> None:
     if exp not in _EXPERIMENTS:
         die(f"unknown experiment {exp!r}; options: {list(_EXPERIMENTS)}")
     if exp != "split" and ("faults" in p or "link_policy" in p
+                           or "fec" in p or "hedge" in p
+                           or "link_health" in p
                            or "deadline" in p or "stage_failure" in p
                            or "recovery" in p):
-        die("faults/link_policy/deadline/stage_failure/recovery only apply "
-            "to experiment 'split'")
+        die("faults/link_policy/fec/hedge/link_health/deadline/stage_failure/"
+            "recovery only apply to experiment 'split'")
     for k in _REQUIRED.get(exp, ()):
         if k not in p:
             die(f"experiment {exp!r} requires key {k!r}")
@@ -203,6 +206,27 @@ def _validate_params_json(p: dict) -> None:
                         get_wire_codec(t)
                     except ValueError as e:
                         die(f"link_policy.tiers: {e}")
+        from .codecs.fec import FECConfig, HedgeConfig, LinkHealthConfig
+
+        for key, cls in (("fec", FECConfig), ("hedge", HedgeConfig),
+                         ("link_health", LinkHealthConfig)):
+            if key not in p:
+                continue
+            if not isinstance(p[key], dict):
+                die(f"{key} must be an object of {cls.__name__} fields, "
+                    f"got {p[key]!r}")
+            fields = {f.name for f in dataclasses.fields(cls)}
+            bad = sorted(set(p[key]) - fields)
+            if bad:
+                die(f"{key}: unknown field(s) {bad}; known: {sorted(fields)}")
+            try:
+                cls(**p[key])
+            except (TypeError, ValueError) as e:
+                die(f"{key}: {e}")
+            if "faults" not in p or not FaultConfig(**p["faults"]).enabled:
+                die(f"{key} requires an enabled 'faults' config (the link "
+                    f"machinery only exists in the graph when a fault can "
+                    f"fire)")
         if "deadline" in p:
             d = p["deadline"]
             if isinstance(d, bool) or not isinstance(d, (int, float)) or d <= 0:
@@ -244,6 +268,35 @@ def _validate_params_json(p: dict) -> None:
                     f"got {mf!r}")
 
 
+def _print_fault_report(result: dict) -> None:
+    """Human-readable tail for ``--fault-report``: the summed per-hop link
+    counters, the tier trail, and (when the SLO tracker ran) the budget burn."""
+    counters = result.get("link_counters")
+    if not counters:
+        print("fault report: no link counters recorded (faults were off)")
+        return
+    n_hops = max((len(v) for v in counters.values()), default=0)
+    rows = [["counter"] + [f"hop{i}" for i in range(n_hops)] + ["total"]]
+    for k in sorted(counters):
+        v = counters[k]
+        rows.append([k] + [str(x) for x in v] + [str(sum(v))])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+    print("fault report (summed per-hop link counters):")
+    for r in rows:
+        print("  " + "  ".join(c.rjust(w) for c, w in zip(r, widths)))
+    if result.get("tier_switches"):
+        print(f"  tier switches: {result['tier_switches']} "
+              f"(final tier {result.get('final_tier', 0)}, "
+              f"{result.get('degraded_chunks', 0)} degraded chunk(s))")
+    lh = result.get("link_health")
+    if lh:
+        print(f"  link health: burn_rate={lh['burn_rate']:.3f} of a "
+              f"{lh['error_budget']:.3%} error budget — corruption "
+              f"{lh['corruption_rate']:.4f}, repair {lh['repair_rate']:.3f}, "
+              f"retry {lh['retry_rate']:.4f}, hedge-win "
+              f"{lh['hedge_win_rate']:.4f}")
+
+
 def main(argv=None) -> int:
     # --lint short-circuits before the parser: the graphlint gate needs no
     # params.json, and running it first means a contract violation is caught
@@ -283,6 +336,11 @@ def main(argv=None) -> int:
                          "checkpoint and exits with a typed DecodeTimeout "
                          "instead of hanging (overrides params.json "
                          "\"deadline\")")
+    ap.add_argument("--fault-report", action="store_true",
+                    help="split experiment: after the sweep, pretty-print the "
+                         "summed per-hop link counters (detected / repaired / "
+                         "retried / hedge wins / substituted), the tier trail, "
+                         "and the link-health budget burn")
     ap.add_argument("--distributed", action="store_true",
                     help="join a multi-host run via jax.distributed.initialize() "
                          "before touching devices; split meshes become "
@@ -459,6 +517,9 @@ def main(argv=None) -> int:
                 metrics_path=out("split_metrics.jsonl"),
                 faults=params_json.get("faults"),
                 link_policy=params_json.get("link_policy"),
+                fec=params_json.get("fec"),
+                hedge=params_json.get("hedge"),
+                link_health=params_json.get("link_health"),
                 deadline_s=(args.deadline_s if args.deadline_s is not None
                             else params_json.get("deadline")),
                 stage_failure=params_json.get("stage_failure"),
@@ -466,6 +527,8 @@ def main(argv=None) -> int:
             with open(out("split_eval_results.json"), "w") as f:
                 json.dump(result, f, indent=1)
             print(json.dumps(result))
+            if args.fault_report:
+                _print_fault_report(result)
             return 0
 
         if experiment == "initial":

@@ -183,7 +183,8 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
                    fault_step: int = 0,
                    stats: Optional[dict] = None,
                    recovery: Optional[RecoveryConfig] = None,
-                   raw_params: Optional[dict] = None) -> jnp.ndarray:
+                   raw_params: Optional[dict] = None,
+                   link_health: Optional[Any] = None) -> jnp.ndarray:
     """``generate`` over the pipeline-SPLIT decode runtime: one split prefill,
     then O(1) :meth:`SplitRuntime.decode_step` calls, every emitted token
     crossing each cut as a packed wire payload — and, when the runtime was
@@ -196,6 +197,13 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
     ``stats`` gains the same timing fields as ``generate`` plus, under faults,
     ``link_counters`` — the per-hop detected/retried/recovered/substituted
     totals incurred by THIS call.
+
+    ``link_health`` (a :class:`~edgellm_tpu.codecs.fec.LinkHealth`) observes
+    this call's counter deltas and lands its windowed SLO summary — burn
+    rate, corruption/repair/retry/hedge-win rates — in
+    ``stats["link_health"]``; the caller reads ``link_health.tier`` between
+    calls to walk the codec ladder (tier changes swap runtimes, so they
+    cannot happen inside one call).
 
     ``recovery`` routes the call through the survivable loop: periodic
     :class:`DecodeCheckpoint` snapshots, a per-step watchdog, stage-failure
@@ -229,6 +237,14 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
     jax.block_until_ready(out)
     t2 = time.monotonic()
 
+    counters1 = rt.link_counters() if hasattr(rt, "link_counters") else None
+    delta = None
+    if counters1 is not None:
+        delta = {k: [int(x) for x in (v if counters0 is None
+                                      else v - counters0[k])]
+                 for k, v in counters1.items()}
+    if link_health is not None:
+        link_health.observe(delta)
     if stats is not None:
         steps = max_new_tokens - 1
         stats.update(
@@ -238,12 +254,10 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
             decode_steps=steps,
             decode_tokens_per_s=(b * steps / (t2 - t1)) if steps else 0.0,
         )
-        counters1 = rt.link_counters() if hasattr(rt, "link_counters") else None
-        if counters1 is not None:
-            stats["link_counters"] = {
-                k: [int(x) for x in (v if counters0 is None
-                                     else v - counters0[k])]
-                for k, v in counters1.items()}
+        if delta is not None:
+            stats["link_counters"] = delta
+        if link_health is not None:
+            stats["link_health"] = link_health.summary()
     return out
 
 

@@ -75,3 +75,78 @@ def test_bench_decode_headline(monkeypatch, capsys, tmp_path):
     assert dec["split"]["hop_bytes_per_token"] == [
         b / 2 for b in dec["split"]["measured_hop_bytes_per_step"]]
     assert json.load(open(tmp_path / "detail.json")) == detail
+
+
+def test_bench_fec_headline(monkeypatch, capsys, tmp_path):
+    """BENCH_FEC=1: the self-healing-link sweep with the same stdout
+    contract — headline carries the repaired-vs-retried split and the
+    declared parity wire overhead."""
+    sys.modules.pop("bench", None)
+    import bench
+
+    monkeypatch.setenv("BENCH_DETAIL_PATH", str(tmp_path / "detail.json"))
+    monkeypatch.setenv("BENCH_FEC", "1")
+    monkeypatch.setenv("BENCH_MODEL", "tiny-qwen2")
+    monkeypatch.setenv("BENCH_DTYPE", "float32")
+    monkeypatch.setenv("BENCH_FEC_RATES", "0,0.0002")
+    monkeypatch.setenv("BENCH_FAULT_CHUNKS", "2")
+    monkeypatch.setenv("BENCH_MAX_LENGTH", "64")
+    monkeypatch.setenv("BENCH_STRIDE", "32")
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    line = json.loads(out[-1])
+    assert line["unit"] == "ppl" and line["value"] > 0
+    assert line["vs_baseline"] is None
+    assert "FEC" in line["metric"]
+    assert line["wire_overhead"] > 0
+    assert len(out[-1]) < 1024
+    assert set(line) <= {
+        "metric", "value", "unit", "vs_baseline", "ppl_clean", "ppl_ratio",
+        "wire_overhead", "detected", "repaired", "retried", "hedge_wins",
+        "substituted", "decode_tokens_per_s_clean",
+        "decode_tokens_per_s_faulty"}
+    detail = json.loads(out[-2])["detail"]
+    fec = detail["fec"]
+    assert fec["sweep"][0]["rate"] == 0  # exact fault-free baseline point
+    assert fec["sweep"][0]["link_counters"] is None
+    assert "repaired" in fec["sweep"][-1]["link_counters"]
+    # the decode leg ran (8 spoofed devices) with all three link builds
+    assert {"clean", "faulty_retry_only", "faulty_fec"} <= set(fec["decode"])
+    assert json.load(open(tmp_path / "detail.json")) == detail
+
+
+def test_bench_backend_outage_emits_status_artifact(monkeypatch, capsys,
+                                                    tmp_path):
+    """An accelerator outage must not kill the bench rc=1 with no artifact:
+    every section preflights the backend and, on failure, emits a partial
+    artifact with an explicit per-section status — and returns success."""
+    sys.modules.pop("bench", None)
+    import bench
+    import jax
+
+    def _dead_backend():
+        raise RuntimeError(
+            "Unable to initialize backend 'axon': UNAVAILABLE: connection "
+            "refused (you may need to restart the tunnel)")
+
+    monkeypatch.setenv("BENCH_DETAIL_PATH", str(tmp_path / "detail.json"))
+    monkeypatch.setenv("BENCH_FEC", "1")
+    monkeypatch.setattr(jax, "devices", _dead_backend)
+    assert bench.main() == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    line = json.loads(out[-1])
+    assert line["status"] == "backend_unavailable"
+    assert line["section"] == "fec" and line["value"] is None
+    detail = json.loads(out[-2])["detail"]
+    assert detail["status"] == "backend_unavailable"
+    assert "axon" in detail["error"]
+    assert json.load(open(tmp_path / "detail.json")) == detail
+
+    # a NON-outage error must still propagate loudly — the status path is
+    # for environmental outages only, never a mask for real bugs
+    def _real_bug():
+        raise RuntimeError("shape mismatch in decode step")
+
+    monkeypatch.setattr(jax, "devices", _real_bug)
+    with pytest.raises(RuntimeError, match="shape mismatch"):
+        bench.main()
