@@ -73,6 +73,23 @@ an injected stage loss with boundary re-planning failover. Knobs:
 BENCH_RECOVERY_PROMPT, BENCH_RECOVERY_TOKENS, BENCH_RECOVERY_BATCH,
 BENCH_RECOVERY_CODEC.
 
+BENCH_OBS=1 switches to the observability smoke (see ``obs_main``): the full
+obs stack armed (metrics registry + span tracer + latency SLOs), a short
+instrumented decode (single-device, plus the 2-stage split when >= 2 devices
+are visible), then a metrics snapshot written to BENCH_OBS_METRICS_PATH
+(default BENCH_OBS_METRICS.json; a .prom/.txt suffix switches to Prometheus
+text format) and a Perfetto-loadable Chrome trace to BENCH_OBS_TRACE_PATH
+(default BENCH_OBS_TRACE.json). Knobs: BENCH_OBS_PROMPT (default 32),
+BENCH_OBS_TOKENS (default 32), BENCH_OBS_BATCH (default 2), plus the shared
+BENCH_MODEL / BENCH_DTYPE.
+
+Every artifact (headline sidecar) carries a ``meta`` provenance block —
+schema_version, git commit, jax/jaxlib versions, backend, UTC timestamp —
+attached centrally in ``_emit``; readers must tolerate its absence in
+artifacts recorded before schema_version 2. When the process-global metrics
+registry is enabled, ``_emit`` also folds its snapshot into the sidecar as
+``detail["metrics"]``.
+
 An over-large BENCH_WINDOW_BATCH never kills the bench: on TPU an AOT
 memory-analysis preflight (tools/wb_preflight.py) halves it to the largest
 batch whose estimated peak fits BEFORE anything runs (a real TPU OOM would
@@ -88,11 +105,61 @@ import numpy as np
 
 REFERENCE_S_PER_CHUNK = 16.0  # qwen2-0.5B_experiment.ipynb cell 12 (BASELINE.md)
 
+# bumped to 2 when the `meta` provenance block + optional `metrics` snapshot
+# landed in the detail sidecar; readers must .get() both (v1 artifacts lack
+# them)
+BENCH_SCHEMA_VERSION = 2
+
+
+def _bench_meta() -> dict:
+    """Provenance block attached to every artifact: enough to tie a recorded
+    number back to the exact build + toolchain that produced it. Every field
+    degrades to None rather than failing the bench — provenance must never
+    cost an artifact."""
+    meta: dict = {
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_commit": None,
+        "jax_version": None,
+        "jaxlib_version": None,
+        "backend": None,
+    }
+    try:
+        import subprocess
+
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            timeout=10, cwd=os.path.dirname(os.path.abspath(__file__)))
+        meta["git_commit"] = proc.stdout.strip() or None
+    except (OSError, subprocess.SubprocessError):
+        pass
+    try:
+        import jax
+        import jaxlib
+
+        meta["jax_version"] = jax.__version__
+        meta["jaxlib_version"] = jaxlib.__version__
+        meta["backend"] = jax.default_backend()
+    except (ImportError, RuntimeError):
+        # backend init can fail on an accelerator outage — the artifact (with
+        # its backend_unavailable status) still deserves its meta block
+        pass
+    return meta
+
 
 def _emit(line: dict, detail: dict) -> None:
     """The stdout/sidecar contract shared by every bench mode: verbose detail
     to an atomic sidecar + an earlier {"detail": ...} line, compact headline
-    JSON as the FINAL line (the driver's tail capture truncates giant lines)."""
+    JSON as the FINAL line (the driver's tail capture truncates giant lines).
+    Centrally stamps the ``meta`` provenance block and, when the global
+    metrics registry is enabled, folds its snapshot in as
+    ``detail["metrics"]``."""
+    detail.setdefault("meta", _bench_meta())
+    from edgellm_tpu.obs.metrics import get_registry
+
+    reg = get_registry()
+    if reg.enabled and "metrics" not in detail:
+        detail["metrics"] = reg.snapshot()
     detail_path = os.environ.get("BENCH_DETAIL_PATH", "BENCH_DETAIL.json")
     try:
         # the harness's atomic tmp+rename writer: never a half-written sidecar
@@ -153,6 +220,22 @@ def decode_main():
         prefill_s.append(st["prefill_s"])
     tokens_per_s = max(passes)  # full precision; rounded only for display
 
+    # SLO leg: the same passes with the LatencyObserver attached — TTFT +
+    # per-token latency percentiles for the headline, and the measured
+    # instrumented-vs-clean throughput delta (the regression test holds this
+    # under 3%; the artifact records the number it enforces)
+    from edgellm_tpu.obs.latency import LatencyObserver
+
+    observe = LatencyObserver()
+    obs_passes = []
+    for _ in range(repeats):
+        st = {}
+        generate(cfg, params, ids, new_tokens, capacity=capacity,
+                 compute_dtype=dtype, stats=st, observe=observe)
+        obs_passes.append(st["decode_tokens_per_s"])
+    slo = observe.summary()
+    obs_overhead = max(0.0, 1.0 - max(obs_passes) / tokens_per_s)
+
     # what a split deployment would move per decode step at this batch: the
     # (B, 1, D) boundary activation through the configured wire codec
     from edgellm_tpu.codecs.packing import get_wire_codec
@@ -169,6 +252,9 @@ def decode_main():
             "decode_step_cache_misses_warm": warm["decode_step_cache_misses"],
             "split_hop_codec": codec_name,
             "split_hop_bytes_per_token": hop_bytes_per_token,
+            "observed_passes_tokens_per_s": [round(p, 2) for p in obs_passes],
+            "obs_overhead_frac": round(obs_overhead, 4),
+            "slo": {k: round(v, 6) for k, v in slo.items()},
         },
     }
 
@@ -211,6 +297,13 @@ def decode_main():
         "batch": batch,
         "decode_step_cache_misses": warm["decode_step_cache_misses"],
     }
+    # the SLO block is the acceptance surface: TTFT + per-token p50/p95/p99
+    # ride the headline (None only if an SLO leg recorded nothing, which a
+    # >= 2-token pass never does)
+    for k in ("ttft_s", "token_latency_p50_s", "token_latency_p95_s",
+              "token_latency_p99_s"):
+        v = slo.get(k)
+        line[k] = round(v, 6) if v is not None else None
     _emit(line, detail)
 
 
@@ -623,6 +716,111 @@ def recovery_main():
     _emit(line, detail)
 
 
+def obs_main():
+    """BENCH_OBS=1: observability smoke — arm the full obs stack (metrics
+    registry + span tracer + latency SLOs), run a short instrumented decode
+    (single-device, plus the 2-stage split when >= 2 devices are visible),
+    and write the two artifacts the runbook promises: a metrics snapshot
+    (BENCH_OBS_METRICS_PATH, default BENCH_OBS_METRICS.json; .prom/.txt
+    suffix switches to Prometheus text format) and a Perfetto-loadable
+    Chrome trace (BENCH_OBS_TRACE_PATH, default BENCH_OBS_TRACE.json). The
+    headline is the instrumented decode tokens/s with the SLO percentiles
+    and span/metric counts alongside; the registry snapshot rides the detail
+    sidecar via ``_emit``'s enabled-registry hook."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu import obs
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.serve.decode import generate, generate_split
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    prompt = int(os.environ.get("BENCH_OBS_PROMPT", "32"))
+    new_tokens = int(os.environ.get("BENCH_OBS_TOKENS", "32"))
+    batch = int(os.environ.get("BENCH_OBS_BATCH", "2"))
+    capacity = prompt + new_tokens
+    metrics_path = os.environ.get("BENCH_OBS_METRICS_PATH",
+                                  "BENCH_OBS_METRICS.json")
+    trace_path = os.environ.get("BENCH_OBS_TRACE_PATH", "BENCH_OBS_TRACE.json")
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt)))
+
+    obs.enable(obs.ObservabilityConfig())
+    # a clean slate: the smoke's artifacts must reflect THIS run, not metrics
+    # or spans a prior section/test left in the process-global state
+    obs.get_registry().clear()
+    obs.get_tracer().clear()
+    try:
+        observe = obs.LatencyObserver()
+        generate(cfg, params, ids, new_tokens, capacity=capacity,
+                 compute_dtype=dtype)  # compile
+        st: dict = {}
+        generate(cfg, params, ids, new_tokens, capacity=capacity,
+                 compute_dtype=dtype, stats=st, observe=observe)
+        tokens_per_s = st["decode_tokens_per_s"]
+
+        detail = {"obs": {
+            "prompt": prompt, "new_tokens": new_tokens, "batch": batch,
+            "slo": {k: round(v, 6) for k, v in observe.summary().items()},
+        }}
+        if len(jax.devices()) >= 2:
+            from edgellm_tpu.parallel.split import (SplitConfig, SplitRuntime,
+                                                    make_stage_mesh)
+
+            cut = cfg.num_layers // 2 - 1
+            rt = SplitRuntime(
+                cfg, SplitConfig(cuts=(cut,),
+                                 hop_codecs=("int8_per_token",)),
+                make_stage_mesh(2))
+            placed = rt.place_params(params)
+            generate_split(rt, placed, ids, new_tokens,
+                           capacity=capacity)  # compile
+            st_split: dict = {}
+            generate_split(rt, placed, ids, new_tokens, capacity=capacity,
+                           stats=st_split, observe=obs.LatencyObserver())
+            detail["obs"]["split"] = {
+                "cut": cut,
+                "decode_tokens_per_s": round(
+                    st_split["decode_tokens_per_s"], 2),
+            }
+
+        # generate() already published the observers' histograms into the
+        # enabled registry; export both artifact shapes from the live state
+        reg = obs.get_registry()
+        tracer = obs.get_tracer()
+        if metrics_path.endswith((".prom", ".txt")):
+            body = reg.to_prometheus()
+        else:
+            body = reg.to_json()
+        with open(metrics_path, "w") as f:
+            f.write(body)
+        tracer.export(trace_path)
+        n_spans = len(tracer.to_chrome_trace()["traceEvents"])
+        print(f"metrics snapshot -> {metrics_path}")
+        print(f"chrome trace -> {trace_path}")
+
+        line = {
+            "metric": (f"{model_name} obs-instrumented decode smoke "
+                       f"(prompt {prompt} +{new_tokens} tokens, "
+                       f"batch {batch})"),
+            "value": round(tokens_per_s, 1),
+            "unit": "decode tokens/s (obs on)",
+            "vs_baseline": None,  # the reference has no telemetry at all
+            "n_metrics": len(reg.names()),
+            "n_spans": n_spans,
+        }
+        for k in ("ttft_s", "token_latency_p50_s", "token_latency_p95_s",
+                  "token_latency_p99_s"):
+            line[k] = detail["obs"]["slo"].get(k)
+        _emit(line, detail)
+    finally:
+        obs.disable()
+
+
 def _backend_unavailable(exc: BaseException) -> bool:
     """True when the error is an accelerator-backend outage (the tunneled
     TPU plugin failing to come up), not a code bug in the bench."""
@@ -667,6 +865,8 @@ def main():
         from edgellm_tpu.lint.__main__ import main as lint_main
 
         raise SystemExit(lint_main(["--no-mypy"]))
+    if os.environ.get("BENCH_OBS") == "1":
+        return _run_section("obs", obs_main)
     if os.environ.get("BENCH_RECOVERY") == "1":
         return _run_section("recovery", recovery_main)
     if os.environ.get("BENCH_DECODE") == "1":

@@ -367,3 +367,13 @@ def sum_counters(counter_list: Optional[Sequence[dict]]) -> Optional[dict]:
         for k, v in c.items():
             tot[k] = tot[k] + np.asarray(v, dtype=np.int64)
     return tot
+
+
+def flatten_counters(counters: Optional[dict]) -> dict:
+    """Collapse a per-hop counter dict ({key: (n_hops,) ints}) to per-key
+    scalar totals: {key: int}. The shape reports and the obs metric adapters
+    want; None/empty in, {} out."""
+    if not counters:
+        return {}
+    return {k: int(np.asarray(v, dtype=np.int64).sum())
+            for k, v in counters.items()}

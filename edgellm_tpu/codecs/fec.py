@@ -394,6 +394,7 @@ class LinkHealth:
         self._window.append(tot)
         self.observations += 1
         if len(self._window) < self.cfg.window:
+            self._publish()
             return self.tier  # not enough evidence yet
         burn = self.burn_rate
         now = self.clock()
@@ -410,7 +411,17 @@ class LinkHealth:
             self.switches += 1
             self._last_switch = now
             self._window.clear()
+        self._publish()
         return self.tier
+
+    def _publish(self) -> None:
+        """Mirror the windowed SLO fields into the global obs registry.
+        Lazy import + enabled gate: with observability off (the default)
+        this is one attribute check per observation."""
+        from ..obs.metrics import get_registry, record_link_health
+
+        if get_registry().enabled:
+            record_link_health(self.summary())
 
     def _sum(self, key: str) -> int:
         return sum(o[key] for o in self._window)

@@ -35,6 +35,9 @@ from ..parallel import SplitConfig, SplitRuntime, make_stage_mesh
 from ..codecs.packing import WireCodec, get_wire_codec, selective_int4
 from ..codecs.faults import FaultConfig, LinkPolicy, TierController, sum_counters
 from ..codecs.fec import FECConfig, HedgeConfig, LinkHealth, LinkHealthConfig
+from ..obs.metrics import (record_link_counters, record_link_health,
+                           record_recovery_counters, record_wire_bytes)
+from ..obs.tracing import span as obs_span
 from ..serve.recovery import (DecodeTimeout, RecoveryCounters, StageFailure,
                               StageLostError, Watchdog)
 from .harness import (ResumableDriver, _emit, _iter_window_groups,
@@ -340,9 +343,11 @@ def run_split_eval(
         hop_bytes_total = list(rd.state["hop_bytes_total"])
 
     def save_checkpoint():
-        rd.save({"total_nll": total_nll, "n_tokens": n_tokens,
-                 "fwd_tokens": fwd_tokens, "real_fwd_tokens": real_fwd_tokens,
-                 "hop_bytes_total": hop_bytes_total})
+        with obs_span("eval.checkpoint_write"):
+            rd.save({"total_nll": total_nll, "n_tokens": n_tokens,
+                     "fwd_tokens": fwd_tokens,
+                     "real_fwd_tokens": real_fwd_tokens,
+                     "hop_bytes_total": hop_bytes_total})
 
     bytes_cache: dict = {}
     degraded_chunks = 0  # chunks that ran below tier 0
@@ -363,19 +368,20 @@ def run_split_eval(
         rcounters.failovers += 1
         from jax.sharding import Mesh
 
-        survivors = np.delete(np.asarray(mesh.devices), lost, axis=0)
-        mesh = Mesh(survivors, ("stage", "data", "model"))
-        split = split.replan(cfg.num_layers, survivors.shape[0])
-        rcounters.replans += 1
-        ladder = [list(split.hop_codecs)]
-        if controller is not None or health is not None:
-            for name in policy.tiers:
-                ladder.append([name] * len(split.hop_codecs))
-        runtimes.clear()
-        runtimes[0] = _make_runtime(ladder[0])
-        placed = runtimes[0].place_params(params)
-        gen += 1
-        gen_bytes[gen] = [0] * len(split.hop_codecs)
+        with obs_span("eval.failover", lost_stage=lost):
+            survivors = np.delete(np.asarray(mesh.devices), lost, axis=0)
+            mesh = Mesh(survivors, ("stage", "data", "model"))
+            split = split.replan(cfg.num_layers, survivors.shape[0])
+            rcounters.replans += 1
+            ladder = [list(split.hop_codecs)]
+            if controller is not None or health is not None:
+                for name in policy.tiers:
+                    ladder.append([name] * len(split.hop_codecs))
+            runtimes.clear()
+            runtimes[0] = _make_runtime(ladder[0])
+            placed = runtimes[0].place_params(params)
+            gen += 1
+            gen_bytes[gen] = [0] * len(split.hop_codecs)
 
     def submit_group(group):
         nonlocal sf_pending
@@ -425,7 +431,9 @@ def run_split_eval(
             return art, logits
 
         try:
-            art, logits = _forward()
+            with obs_span("eval.submit_group", chunk=group[0].index,
+                          tier=tier):
+                art, logits = _forward()
         except StageLostError as e:
             _eval_failover(e.stage)
             art, logits = _forward()  # same chunk, re-planned boundary
@@ -436,6 +444,10 @@ def run_split_eval(
                 chunk_counters, art, gen)
 
     def drain_group(rec):
+        with obs_span("eval.drain_group", chunk=rec[0][-1].index):
+            _drain_impl(rec)
+
+    def _drain_impl(rec):
         nonlocal total_nll, n_tokens, fwd_tokens, real_fwd_tokens
         nonlocal degraded_chunks
         (group, n_real, s_unpadded, counts, (w, s_chunk), nlls, tier,
@@ -571,8 +583,18 @@ def run_split_eval(
     if time_hops and rd.chunks:
         t_seq = seq if n_seq <= 1 else seq + (-seq) % n_seq
         # after a failover, time the boundary that actually finished the run
-        result["per_hop_ms"] = (runtimes[0] if rcounters.failovers
-                                else rt).time_hops(1, t_seq)
+        with obs_span("eval.time_hops", seq=t_seq):
+            result["per_hop_ms"] = (runtimes[0] if rcounters.failovers
+                                    else rt).time_hops(1, t_seq)
+    # mirror this sweep's totals into the global registry (no-ops when
+    # observability is off): wire bytes, fault/health/recovery counters
+    record_wire_bytes(hop_bytes_total, kind="eval_forward")
+    if fault_on:
+        record_link_counters(result["link_counters"])
+        if health is not None:
+            record_link_health(result["link_health"])
+    if recovery_on:
+        record_recovery_counters(rcounters)
     final_rec = {"final": True, "chunks": rd.chunks, "n_tokens": n_tokens,
                  "ppl": result["ppl"], "wall_s": wall,
                  "hop_bytes_total": hop_bytes_total,

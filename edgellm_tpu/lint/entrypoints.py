@@ -249,4 +249,38 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     (findings.extend(ident) if ident
      else checked.append("split.decode_step.zero-fault-identity"))
 
+    # ---- observability identity: ARMING the obs stack (registry + tracer
+    # ---- on, a span open on this thread) must not change a single jaxpr
+    # ---- byte — every instrument is host-side, at sample boundaries, never
+    # ---- inside the compiled graph ---------------------------------------
+    from .. import obs
+
+    def _armed(fn: Callable) -> Callable:
+        """Trace ``fn`` with the full obs stack enabled and an open span, so
+        any graph residue (a host callback, a metric op) flips the hash."""
+        def traced(*args):
+            obs.enable(obs.ObservabilityConfig())
+            try:
+                with obs.span("lint.obs-identity-probe"):
+                    return fn(*args)
+            finally:
+                obs.disable()
+        return traced
+
+    ident = check_identity(
+        "split.forward.obs-enabled-identity",
+        rt._forward, (placed, ids, imps),
+        _armed(rt._forward), (placed, ids, imps),
+        what="obs-enabled forward graph")
+    (findings.extend(ident) if ident
+     else checked.append("split.forward.obs-enabled-identity"))
+
+    ident = check_identity(
+        "split.decode_step.obs-enabled-identity",
+        step_fn, (placed, k_cache, v_cache, length, tok),
+        _armed(step_fn), (placed, k_cache, v_cache, length, tok),
+        what="obs-enabled decode-step graph")
+    (findings.extend(ident) if ident
+     else checked.append("split.decode_step.obs-enabled-identity"))
+
     return findings, checked, skipped

@@ -25,6 +25,7 @@ TYPED_MODULES = (
     "edgellm_tpu/serve/recovery.py",
     "edgellm_tpu/parallel/split.py",
     "edgellm_tpu/codecs/faults.py",
+    "edgellm_tpu/obs/metrics.py",
 )
 
 _LINE_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+):(?:\d+:)?\s*"

@@ -1,0 +1,128 @@
+"""Per-token latency SLOs for the decode loops: TTFT and inter-token
+latency histograms, measured without serializing the dispatch stream.
+
+The measurement discipline matters more than the histogram: JAX dispatch is
+async, so a naive ``time.monotonic()`` around each step measures *enqueue*
+latency (microseconds) not *token* latency. A ``block_until_ready`` on
+every intermediate would be worse — it serializes the stream the decode
+loop deliberately keeps deep. The correct boundary is the **sampled
+token**: the (B,) int32 array each step must materialize anyway before it
+feeds the next step's embedding lookup. :meth:`LatencyObserver.token`
+blocks on exactly that array — one host sync per token, at a point the
+data dependency already forces — so observed latency is true per-token
+wall clock and overhead stays inside the 3% budget the regression test
+enforces (EG005's host-sync lint explicitly allows ``block_until_ready``
+for this reason; ``.item()`` in the loop would be flagged).
+
+``generate``/``generate_split`` accept ``observe=LatencyObserver(...)``;
+with ``observe=None`` (default) the loops are untouched.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, Optional
+
+from . import metrics as _metrics
+
+__all__ = ["LatencyObserver"]
+
+
+def _block(x: Any) -> None:
+    """Block until the sampled token is on host-reachable memory. Guarded:
+    numpy arrays (already host) and test doubles pass through."""
+    try:
+        import jax
+        jax.block_until_ready(x)
+    except ImportError:  # pragma: no cover - bare-stdlib fallback
+        pass
+
+
+class LatencyObserver:
+    """Accumulates TTFT and per-token latency for one or more generate calls.
+
+    Protocol (driven by the decode loops):
+
+    - :meth:`start` at the top of a call, before prefill dispatch;
+    - :meth:`first_token` with the prefill-sampled token — blocks on it,
+      records time-to-first-token;
+    - :meth:`token` with each decode step's sampled token — blocks on it,
+      records the inter-token gap;
+    - :meth:`summary` for the ``{ttft_s, p50/p95/p99, ...}`` dict the
+      caller folds into ``stats``; :meth:`publish` mirrors both histograms
+      into the global registry (self-gated on ``registry.enabled``).
+
+    Histograms span 10µs–100s with ~3%-wide log buckets, so p99 is exact
+    to well under the bucket width at any realistic token rate.
+    """
+
+    def __init__(self, registry: Optional[_metrics.MetricsRegistry] = None) -> None:
+        self._registry = registry
+        self._ttft = _metrics.Histogram(
+            "edgellm_decode_ttft_seconds",
+            "prefill start to first sampled token",
+            lo=1e-5, hi=1e2, n_buckets=480)
+        self._tok = _metrics.Histogram(
+            "edgellm_decode_token_latency_seconds",
+            "gap between consecutive sampled tokens",
+            lo=1e-5, hi=1e2, n_buckets=480)
+        self._t_start: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    def start(self) -> None:
+        self._t_start = time.monotonic()
+        self._t_last = None
+
+    def first_token(self, sampled: Any) -> None:
+        _block(sampled)
+        now = time.monotonic()
+        if self._t_start is not None:
+            self._ttft.observe(now - self._t_start)
+        self._t_last = now
+
+    def token(self, sampled: Any) -> None:
+        _block(sampled)
+        now = time.monotonic()
+        if self._t_last is not None:
+            self._tok.observe(now - self._t_last)
+        self._t_last = now
+
+    @property
+    def ttft(self) -> _metrics.Histogram:
+        return self._ttft
+
+    @property
+    def token_latency(self) -> _metrics.Histogram:
+        return self._tok
+
+    def summary(self) -> Dict[str, float]:
+        """The SLO block ``generate`` folds into its stats dict."""
+        out: Dict[str, float] = {}
+        tp = self._ttft.percentiles()
+        if self._ttft.count:
+            out["ttft_s"] = tp["mean"]
+            out["ttft_p50_s"] = tp["p50"]
+        kp = self._tok.percentiles()
+        if self._tok.count:
+            out["token_latency_p50_s"] = kp["p50"]
+            out["token_latency_p95_s"] = kp["p95"]
+            out["token_latency_p99_s"] = kp["p99"]
+            out["token_latency_mean_s"] = kp["mean"]
+            if kp["mean"] and not math.isnan(kp["mean"]):
+                out["tokens_per_s_observed"] = 1.0 / kp["mean"]
+        return out
+
+    def publish(self) -> None:
+        """Mirror the private histograms into the (global or injected)
+        registry so exporters and ``--metrics-out`` see them. Self-gated:
+        a disabled registry records nothing."""
+        reg = (self._registry if self._registry is not None
+               else _metrics.get_registry())
+        if not reg.enabled:
+            return
+        for h in (self._ttft, self._tok):
+            dst = reg.histogram(h.name, h.help, lo=h.edges[1],
+                                hi=h.edges[-1],
+                                n_buckets=len(h.edges) - 2)
+            if dst is not h:
+                dst.merge_from(h)

@@ -478,6 +478,13 @@ class SplitRingRuntime:
         """Per-hop boundary bytes per token (the BASELINE.json metric)."""
         return [b / seq for b in self.hop_bytes(1, seq)]
 
+    def decode_hop_bytes(self, batch: int) -> list:
+        """No per-token decode surface on the ring runtime (it is a
+        whole-window forward) — nothing crosses a wire per decode step.
+        Present so the runtime satisfies the
+        :class:`~edgellm_tpu.obs.metrics.CounterSource` protocol."""
+        return []
+
     def time_hops(self, batch: int, seq: int, iters: int = 20) -> list:
         """Per-hop transfer time (ms) with the probe activation seq-sharded the
         way the runtime's hops actually move it (each device sends its local

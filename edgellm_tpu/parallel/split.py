@@ -568,6 +568,19 @@ class SplitRuntime:
             self._counter_accum = []
         return tot
 
+    def wire_summary(self, batch: int, seq: int) -> list:
+        """Per-hop wire accounting in one shot — the shape the obs registry
+        and bench artifacts consume: codec name, whole-window forward bytes,
+        single-step decode bytes, and steady-state bytes/token."""
+        fwd = self.hop_bytes(batch, seq)
+        dec = self.decode_hop_bytes(batch)
+        per_tok = self.bytes_per_token(seq)
+        return [{"hop": i, "codec": self.codecs[i].name,
+                 "forward_bytes": int(fwd[i]),
+                 "decode_step_bytes": int(dec[i]) if i < len(dec) else 0,
+                 "bytes_per_token": float(per_tok[i])}
+                for i in range(len(self.codecs))]
+
     # ---------- incremental decode ----------
     #
     # The regime where the paper's boundary-quantization question bites

@@ -108,6 +108,7 @@ _PARAM_KEYS = {
     "fec": "split", "hedge": "split", "link_health": "split",
     "deadline": "split", "stage_failure": "split", "recovery": "split",
     "max_compiles": "distances",
+    "observability": "all",
 }
 _EXPERIMENTS = ("", "initial", "last_row", "relevance", "split", "distances")
 _REQUIRED = {"split": ("cuts", "hop_codecs"),
@@ -132,6 +133,22 @@ def _validate_params_json(p: dict) -> None:
     exp = p.get("experiment", "")
     if exp not in _EXPERIMENTS:
         die(f"unknown experiment {exp!r}; options: {list(_EXPERIMENTS)}")
+    if "observability" in p:
+        from .obs import ObservabilityConfig
+
+        ob = p["observability"]
+        if not isinstance(ob, dict):
+            die(f"observability must be an object of ObservabilityConfig "
+                f"fields, got {ob!r}")
+        fields = {f.name for f in dataclasses.fields(ObservabilityConfig)}
+        bad = sorted(set(ob) - fields)
+        if bad:
+            die(f"observability: unknown field(s) {bad}; "
+                f"known: {sorted(fields)}")
+        try:
+            ObservabilityConfig(**ob)
+        except (TypeError, ValueError) as e:
+            die(f"observability: {e}")
     if exp != "split" and ("faults" in p or "link_policy" in p
                            or "fec" in p or "hedge" in p
                            or "link_health" in p
@@ -269,32 +286,31 @@ def _validate_params_json(p: dict) -> None:
 
 
 def _print_fault_report(result: dict) -> None:
-    """Human-readable tail for ``--fault-report``: the summed per-hop link
-    counters, the tier trail, and (when the SLO tracker ran) the budget burn."""
+    """Human-readable tail for ``--fault-report``, routed through the obs
+    metrics registry: link counters, link-health gauges, and recovery
+    counters all land in one registry and print as ONE unified table
+    (was three hand-formatted ones), plus the tier trail."""
+    from .codecs.faults import flatten_counters
+    from .obs.metrics import (MetricsRegistry, format_table,
+                              record_link_counters, record_link_health,
+                              record_recovery_counters)
+
     counters = result.get("link_counters")
     if not counters:
         print("fault report: no link counters recorded (faults were off)")
         return
-    n_hops = max((len(v) for v in counters.values()), default=0)
-    rows = [["counter"] + [f"hop{i}" for i in range(n_hops)] + ["total"]]
-    for k in sorted(counters):
-        v = counters[k]
-        rows.append([k] + [str(x) for x in v] + [str(sum(v))])
-    widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
-    print("fault report (summed per-hop link counters):")
-    for r in rows:
-        print("  " + "  ".join(c.rjust(w) for c, w in zip(r, widths)))
+    reg = MetricsRegistry(enabled=True)
+    record_link_counters(counters, registry=reg)
+    for k, total in flatten_counters(counters).items():
+        reg.counter(f"edgellm_link_{k}_total").inc(total, hop="total")
+    record_link_health(result.get("link_health"), registry=reg)
+    record_recovery_counters((result.get("recovery") or {}).get("counters"),
+                             registry=reg)
+    print(format_table(reg, title="fault report (obs metrics registry)"))
     if result.get("tier_switches"):
         print(f"  tier switches: {result['tier_switches']} "
               f"(final tier {result.get('final_tier', 0)}, "
               f"{result.get('degraded_chunks', 0)} degraded chunk(s))")
-    lh = result.get("link_health")
-    if lh:
-        print(f"  link health: burn_rate={lh['burn_rate']:.3f} of a "
-              f"{lh['error_budget']:.3%} error budget — corruption "
-              f"{lh['corruption_rate']:.4f}, repair {lh['repair_rate']:.3f}, "
-              f"retry {lh['retry_rate']:.4f}, hedge-win "
-              f"{lh['hedge_win_rate']:.4f}")
 
 
 def main(argv=None) -> int:
@@ -336,6 +352,15 @@ def main(argv=None) -> int:
                          "checkpoint and exits with a typed DecodeTimeout "
                          "instead of hanging (overrides params.json "
                          "\"deadline\")")
+    ap.add_argument("--metrics-out", metavar="PATH",
+                    help="enable the obs metrics registry and write its final "
+                         "snapshot to PATH after the experiment — Prometheus "
+                         "text format for .prom/.txt, JSON otherwise "
+                         "(REPRODUCING §10)")
+    ap.add_argument("--trace-out", metavar="PATH",
+                    help="enable host-side span tracing and write the Chrome "
+                         "trace-event JSON to PATH (load at ui.perfetto.dev); "
+                         "composes with --profile's XLA capture")
     ap.add_argument("--fault-report", action="store_true",
                     help="split experiment: after the sweep, pretty-print the "
                          "summed per-hop link counters (detected / repaired / "
@@ -383,11 +408,38 @@ def main(argv=None) -> int:
 
     import contextlib
 
-    if args.profile:
-        from .utils.profiling import trace as _xla_trace
-        profile_cm = _xla_trace(args.profile)
-    else:
-        profile_cm = contextlib.nullcontext()
+    from .obs.tracing import trace_capture
+
+    profile_cm = (trace_capture(args.profile) if args.profile
+                  else contextlib.nullcontext())
+
+    # --metrics-out / --trace-out arm the obs subsystem; a params.json
+    # "observability" object picks the pillars (flags force their own pillar
+    # on — asking for an output file implies wanting its contents)
+    from . import obs
+
+    obs_params = params_json.get("observability")
+    if args.metrics_out or args.trace_out or obs_params is not None:
+        ob_cfg = obs.ObservabilityConfig(**(obs_params or {}))
+        if args.metrics_out or args.trace_out:
+            ob_cfg = dataclasses.replace(
+                ob_cfg,
+                metrics=ob_cfg.metrics or bool(args.metrics_out),
+                tracing=ob_cfg.tracing or bool(args.trace_out))
+        obs.enable(ob_cfg)
+
+    def _export_observability() -> None:
+        if args.metrics_out:
+            reg = obs.get_registry()
+            text = (reg.to_prometheus()
+                    if args.metrics_out.endswith((".prom", ".txt"))
+                    else reg.to_json(indent=1))
+            with open(args.metrics_out, "w") as f:
+                f.write(text)
+            print(f"metrics snapshot -> {args.metrics_out}", flush=True)
+        if args.trace_out:
+            obs.get_tracer().export(args.trace_out)
+            print(f"chrome trace -> {args.trace_out}", flush=True)
 
     def _dispatch() -> int:
         experiment = params_json.get("experiment", "")
@@ -577,7 +629,12 @@ def main(argv=None) -> int:
         return 0
 
     with profile_cm:
-        return _dispatch()
+        try:
+            return _dispatch()
+        finally:
+            # export even when the experiment dies: a partial trace/snapshot
+            # is exactly what a post-mortem needs
+            _export_observability()
 
 
 if __name__ == "__main__":

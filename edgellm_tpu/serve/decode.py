@@ -43,6 +43,11 @@ from ..lint import graph_contract
 from ..models.configs import ModelConfig
 from ..models.transformer import (KVCache, cache_from_state_dict,
                                   cache_state_dict, decode_step, prefill)
+from ..obs.latency import LatencyObserver
+from ..obs.metrics import (CounterSource, get_registry, record_decode_stats,
+                           record_link_counters, record_link_health,
+                           record_recovery_counters, record_wire_bytes)
+from ..obs.tracing import span as obs_span
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        LocalRuntime, RecoveryConfig, RecoveryCounters,
                        StageLostError, Watchdog, runtime_plan_meta)
@@ -119,7 +124,8 @@ def generate(cfg: ModelConfig, params: dict, prompt_ids: ArrayLike,
              rng_key: Optional[jax.Array] = None,
              compute_dtype=None,
              stats: Optional[dict] = None,
-             recovery: Optional[RecoveryConfig] = None) -> jnp.ndarray:
+             recovery: Optional[RecoveryConfig] = None,
+             observe: Optional[LatencyObserver] = None) -> jnp.ndarray:
     """Generate ``max_new_tokens`` per batch row after a KV-cached prefill.
 
     prompt_ids: (B, S) int token ids. Returns (B, max_new_tokens) int32.
@@ -127,6 +133,11 @@ def generate(cfg: ModelConfig, params: dict, prompt_ids: ArrayLike,
     prompts that would overflow it raise instead of silently wrapping.
     ``stats``, when given, is filled with timing and the per-step jit
     cache-miss delta (0 on a warm shape, 1 on a cold one).
+
+    ``observe``: a :class:`~edgellm_tpu.obs.latency.LatencyObserver` records
+    TTFT and per-token latency histograms, blocking once per sampled token
+    (the data-dependency boundary — never per op); its SLO summary is folded
+    into ``stats``. ``observe=None`` (default) leaves the loop untouched.
 
     ``recovery``: a :class:`~edgellm_tpu.serve.recovery.RecoveryConfig`
     routes the generation through the survivable loop (checkpointing +
@@ -141,22 +152,30 @@ def generate(cfg: ModelConfig, params: dict, prompt_ids: ArrayLike,
         rt = LocalRuntime(cfg, compute_dtype)
         return _survivable_loop(rt, params, prompt_ids, max_new_tokens,
                                 capacity, temperature, key, 0, stats,
-                                recovery, raw_params=params)
+                                recovery, raw_params=params, observe=observe)
     misses0 = decode_step_cache_size()
+    if observe is not None:
+        observe.start()
 
     t0 = time.monotonic()
-    last_logits, cache = _prefill_jit(cfg, params, prompt_ids, capacity,
-                                      compute_dtype)
-    tok = _sample(last_logits, jax.random.fold_in(key, 0), temperature)
-    jax.block_until_ready(tok)
+    with obs_span("generate.prefill", batch=b, prompt_len=s):
+        last_logits, cache = _prefill_jit(cfg, params, prompt_ids, capacity,
+                                          compute_dtype)
+        tok = _sample(last_logits, jax.random.fold_in(key, 0), temperature)
+        jax.block_until_ready(tok)
+    if observe is not None:
+        observe.first_token(tok)
     t1 = time.monotonic()
 
     toks = [tok]
-    for t in range(1, max_new_tokens):
-        tok, cache = _step_jit(cfg, params, cache, tok,
-                               jax.random.fold_in(key, t), temperature,
-                               compute_dtype)
-        toks.append(tok)
+    with obs_span("generate.decode_loop", steps=max_new_tokens - 1):
+        for t in range(1, max_new_tokens):
+            tok, cache = _step_jit(cfg, params, cache, tok,
+                                   jax.random.fold_in(key, t), temperature,
+                                   compute_dtype)
+            if observe is not None:
+                observe.token(tok)
+            toks.append(tok)
     out = jnp.stack(toks, axis=1)  # (B, max_new_tokens)
     jax.block_until_ready(out)
     t2 = time.monotonic()
@@ -171,6 +190,11 @@ def generate(cfg: ModelConfig, params: dict, prompt_ids: ArrayLike,
             decode_tokens_per_s=(b * steps / (t2 - t1)) if steps else 0.0,
             decode_step_cache_misses=decode_step_cache_size() - misses0,
         )
+        if observe is not None:
+            stats.update(observe.summary())
+        record_decode_stats(stats)
+    if observe is not None:
+        observe.publish()
     return out
 
 
@@ -184,7 +208,8 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
                    stats: Optional[dict] = None,
                    recovery: Optional[RecoveryConfig] = None,
                    raw_params: Optional[dict] = None,
-                   link_health: Optional[Any] = None) -> jnp.ndarray:
+                   link_health: Optional[Any] = None,
+                   observe: Optional[LatencyObserver] = None) -> jnp.ndarray:
     """``generate`` over the pipeline-SPLIT decode runtime: one split prefill,
     then O(1) :meth:`SplitRuntime.decode_step` calls, every emitted token
     crossing each cut as a packed wire payload — and, when the runtime was
@@ -218,26 +243,35 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
     if recovery is not None:
         return _survivable_loop(rt, placed_params, prompt_ids, max_new_tokens,
                                 capacity, temperature, key, fault_step, stats,
-                                recovery, raw_params=raw_params)
-    counters0 = rt.link_counters() if hasattr(rt, "link_counters") else None
+                                recovery, raw_params=raw_params,
+                                observe=observe)
+    counters0 = rt.link_counters() if isinstance(rt, CounterSource) else None
+    if observe is not None:
+        observe.start()
 
     t0 = time.monotonic()
-    logits, cache = rt.prefill_decode(placed_params, prompt_ids, capacity,
-                                      fault_step=fault_step)
-    tok = _sample(logits[:, -1], jax.random.fold_in(key, 0), temperature)
-    jax.block_until_ready(tok)
+    with obs_span("generate_split.prefill", batch=b, prompt_len=s):
+        logits, cache = rt.prefill_decode(placed_params, prompt_ids, capacity,
+                                          fault_step=fault_step)
+        tok = _sample(logits[:, -1], jax.random.fold_in(key, 0), temperature)
+        jax.block_until_ready(tok)
+    if observe is not None:
+        observe.first_token(tok)
     t1 = time.monotonic()
 
     toks = [tok]
-    for t in range(1, max_new_tokens):
-        step_logits, cache = rt.decode_step(placed_params, cache, tok)
-        tok = _sample(step_logits, jax.random.fold_in(key, t), temperature)
-        toks.append(tok)
+    with obs_span("generate_split.decode_loop", steps=max_new_tokens - 1):
+        for t in range(1, max_new_tokens):
+            step_logits, cache = rt.decode_step(placed_params, cache, tok)
+            tok = _sample(step_logits, jax.random.fold_in(key, t), temperature)
+            if observe is not None:
+                observe.token(tok)
+            toks.append(tok)
     out = jnp.stack(toks, axis=1)  # (B, max_new_tokens)
     jax.block_until_ready(out)
     t2 = time.monotonic()
 
-    counters1 = rt.link_counters() if hasattr(rt, "link_counters") else None
+    counters1 = rt.link_counters() if isinstance(rt, CounterSource) else None
     delta = None
     if counters1 is not None:
         delta = {k: [int(x) for x in (v if counters0 is None
@@ -245,6 +279,12 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
                  for k, v in counters1.items()}
     if link_health is not None:
         link_health.observe(delta)
+    record_link_counters(delta)
+    if link_health is not None:
+        record_link_health(link_health.summary())
+    if get_registry().enabled and isinstance(rt, CounterSource):
+        record_wire_bytes(rt.decode_hop_bytes(b), kind="decode",
+                          steps=max_new_tokens - 1)
     if stats is not None:
         steps = max_new_tokens - 1
         stats.update(
@@ -258,6 +298,11 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
             stats["link_counters"] = delta
         if link_health is not None:
             stats["link_health"] = link_health.summary()
+        if observe is not None:
+            stats.update(observe.summary())
+        record_decode_stats(stats)
+    if observe is not None:
+        observe.publish()
     return out
 
 
@@ -273,23 +318,24 @@ def _write_checkpoint(rec: RecoveryConfig, rt, counters: RecoveryCounters,
     atomic checkpoint file. ``toks`` holds steps 0..t; the cache holds the
     prompt plus steps 0..t-1 (step t's token has not been fed back yet),
     which is exactly the loop state at the top of iteration t+1."""
-    arrays = {
-        "prompt_ids": np.asarray(prompt_ids, np.int32),
-        "tokens": np.stack([np.asarray(x) for x in toks], axis=1)
-        .astype(np.int32),
-        "rng_key": np.asarray(jax.random.key_data(key)),
-    }
-    cs = cache_state_dict(cache)
-    arrays.update({"cache/k": cs["k"], "cache/v": cs["v"],
-                   "cache/length": cs["length"]})
-    meta = {**runtime_plan_meta(rt), **run_meta, "step": int(t),
-            "recovery_counters": counters.as_dict()}
-    link = rt.link_counters() if hasattr(rt, "link_counters") else None
-    if link is not None:
-        meta["link_counters"] = {k: [int(x) for x in v]
-                                 for k, v in link.items()}
-    DecodeCheckpoint(arrays, meta).save(rec.checkpoint_path)
-    counters.checkpoints_written += 1
+    with obs_span("decode.checkpoint_write", step=t):
+        arrays = {
+            "prompt_ids": np.asarray(prompt_ids, np.int32),
+            "tokens": np.stack([np.asarray(x) for x in toks], axis=1)
+            .astype(np.int32),
+            "rng_key": np.asarray(jax.random.key_data(key)),
+        }
+        cs = cache_state_dict(cache)
+        arrays.update({"cache/k": cs["k"], "cache/v": cs["v"],
+                       "cache/length": cs["length"]})
+        meta = {**runtime_plan_meta(rt), **run_meta, "step": int(t),
+                "recovery_counters": counters.as_dict()}
+        link = rt.link_counters() if isinstance(rt, CounterSource) else None
+        if link is not None:
+            meta["link_counters"] = {k: [int(x) for x in v]
+                                     for k, v in link.items()}
+        DecodeCheckpoint(arrays, meta).save(rec.checkpoint_path)
+        counters.checkpoints_written += 1
 
 
 def _decode_failover(rt, raw_params, lost_stage: int, prompt_ids, toks: list,
@@ -312,6 +358,16 @@ def _decode_failover(rt, raw_params, lost_stage: int, prompt_ids, toks: list,
             "stage failover needs raw_params= (the unplaced parameter "
             "pytree) to re-place weights onto the surviving devices")
     counters.failovers += 1
+    with obs_span("decode.failover", lost_stage=lost_stage):
+        return _decode_failover_impl(rt, raw_params, lost_stage, prompt_ids,
+                                     toks, capacity, fault_step, counters)
+
+
+def _decode_failover_impl(rt, raw_params, lost_stage: int, prompt_ids,
+                          toks: list, capacity: int, fault_step: int,
+                          counters: RecoveryCounters):
+    """The replan + re-place + re-prefill body of :func:`_decode_failover`
+    (split out so the failover span covers exactly the expensive work)."""
     grid = np.asarray(rt.mesh.devices)  # (stage, data, model)
     survivors = np.delete(grid, lost_stage, axis=0)
     cfg = rt.cfg
@@ -348,7 +404,8 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
                      capacity: int, temperature: float, key, fault_step: int,
                      stats: Optional[dict], rec: RecoveryConfig,
                      raw_params: Optional[dict],
-                     resume_state=None, resumed: bool = False) -> jnp.ndarray:
+                     resume_state=None, resumed: bool = False,
+                     observe: Optional[LatencyObserver] = None) -> jnp.ndarray:
     """The decode loop with recovery orchestration around the unchanged
     runtime executables. ``resume_state`` = (last_done_step, toks, cache)
     continues a checkpointed generation from step ``last_done_step + 1``."""
@@ -362,8 +419,10 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
                 "max_new_tokens": int(max_new_tokens),
                 "fault_step": int(fault_step), "prompt_len": int(s),
                 "batch": int(b)}
-    counters0 = rt.link_counters() if hasattr(rt, "link_counters") else None
+    counters0 = rt.link_counters() if isinstance(rt, CounterSource) else None
     halted_at = None
+    if observe is not None:
+        observe.start()
 
     def post_step(t, toks, cache) -> bool:
         """halt hook, periodic checkpoint, watchdog — in that order; returns
@@ -406,6 +465,8 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
                 fault_step, counters, rec)
         tok = _sample(last, jax.random.fold_in(key, 0), temperature)
         jax.block_until_ready(tok)
+        if observe is not None:
+            observe.first_token(tok)
         t1 = time.monotonic()
         toks = [tok]
         start_t = 1
@@ -431,6 +492,8 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
                     rt, raw_params, e.stage, prompt_ids, toks, capacity,
                     fault_step, counters, rec)
                 tok = _sample(last, jax.random.fold_in(key, t), temperature)
+            if observe is not None:
+                observe.token(tok)
             toks.append(tok)
             if post_step(t, toks, cache):
                 halted_at = t
@@ -457,7 +520,7 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
         if halted_at is not None:
             stats["halted_at_step"] = halted_at
         stats["recovery_counters"] = counters.as_dict()
-        counters1 = rt.link_counters() if hasattr(rt, "link_counters") else None
+        counters1 = rt.link_counters() if isinstance(rt, CounterSource) else None
         if counters1 is not None:
             # after a failover the runtime is new, so deltas vs the original
             # runtime's baseline are meaningless — report absolute totals
@@ -466,13 +529,21 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
                     (v if counters0 is None or counters.failovers
                      else v - counters0[k])]
                 for k, v in counters1.items()}
+            record_link_counters(stats["link_counters"])
+        if observe is not None:
+            stats.update(observe.summary())
+        record_decode_stats(stats)
+    record_recovery_counters(counters)
+    if observe is not None:
+        observe.publish()
     return out
 
 
 def resume_split(rt: Any, placed_params: dict, checkpoint_path: str, *,
                  stats: Optional[dict] = None,
                  recovery: Optional[RecoveryConfig] = None,
-                 raw_params: Optional[dict] = None) -> jnp.ndarray:
+                 raw_params: Optional[dict] = None,
+                 observe: Optional[LatencyObserver] = None) -> jnp.ndarray:
     """Resume a checkpointed generation and return the FULL (B, max_new)
     token matrix — the checkpointed prefix plus the tokens decoded here,
     token-identical to the uninterrupted same-seed run.
@@ -485,7 +556,8 @@ def resume_split(rt: Any, placed_params: dict, checkpoint_path: str, *,
     decode-step indices, comparable to the checkpoint's ``step``. Works for
     both split runtimes and :class:`LocalRuntime` (unsplit ``generate``
     checkpoints)."""
-    ckpt = DecodeCheckpoint.load(checkpoint_path)
+    with obs_span("decode.checkpoint_resume", path=checkpoint_path):
+        ckpt = DecodeCheckpoint.load(checkpoint_path)
     meta = ckpt.meta
     want = runtime_plan_meta(rt)
     for k, label in (("mode", "runtime mode"), ("model", "model signature"),
@@ -516,4 +588,4 @@ def resume_split(rt: Any, placed_params: dict, checkpoint_path: str, *,
         rt, placed_params, prompt_ids, int(meta["max_new_tokens"]),
         int(meta["capacity"]), float(meta["temperature"]), key,
         int(meta["fault_step"]), stats, rec, raw_params,
-        resume_state=(step, toks, cache), resumed=True)
+        resume_state=(step, toks, cache), resumed=True, observe=observe)

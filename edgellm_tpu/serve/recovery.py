@@ -45,6 +45,7 @@ import numpy as np
 import jax
 
 from ..models.transformer import KVCache, decode_step, prefill
+from ..obs.tracing import span as obs_span
 
 
 # ---------------------------------------------------------------------------
@@ -246,28 +247,36 @@ class DecodeCheckpoint:
         self.meta = dict(meta)
 
     def save(self, path: str) -> str:
-        names = sorted(self.arrays)
-        leaves = [{"name": n, "dtype": str(self.arrays[n].dtype),
-                   "shape": list(self.arrays[n].shape)} for n in names]
-        header = json.dumps({"meta": self.meta, "leaves": leaves},
-                            sort_keys=True).encode()
-        body = b"".join(np.ascontiguousarray(self.arrays[n]).tobytes()
-                        for n in names)
-        payload = struct.pack("<I", len(header)) + header + body
-        blob = _HEADER.pack(_MAGIC, _VERSION, len(payload),
-                            zlib.crc32(payload)) + payload
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        tmp = path + ".part"  # same atomic pattern as hf_loader.fetch_with_retry
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
+        with obs_span("recovery.checkpoint_save", path=path) as sp:
+            names = sorted(self.arrays)
+            leaves = [{"name": n, "dtype": str(self.arrays[n].dtype),
+                       "shape": list(self.arrays[n].shape)} for n in names]
+            header = json.dumps({"meta": self.meta, "leaves": leaves},
+                                sort_keys=True).encode()
+            body = b"".join(np.ascontiguousarray(self.arrays[n]).tobytes()
+                            for n in names)
+            payload = struct.pack("<I", len(header)) + header + body
+            blob = _HEADER.pack(_MAGIC, _VERSION, len(payload),
+                                zlib.crc32(payload)) + payload
+            if sp is not None:
+                sp.args["bytes"] = len(blob)
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            tmp = path + ".part"  # atomic, as in hf_loader.fetch_with_retry
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
         return path
 
     @classmethod
     def load(cls, path: str) -> "DecodeCheckpoint":
+        with obs_span("recovery.checkpoint_load", path=path):
+            return cls._load_impl(path)
+
+    @classmethod
+    def _load_impl(cls, path: str) -> "DecodeCheckpoint":
         try:
             with open(path, "rb") as f:
                 blob = f.read()

@@ -16,21 +16,19 @@ import jax
 
 @contextlib.contextmanager
 def trace(log_dir: str):
-    """Capture an XLA profiler trace for the enclosed block.
+    """Deprecated shim over :func:`edgellm_tpu.obs.tracing.trace_capture`
+    (same contract: capture an XLA profiler trace for the enclosed block,
+    degrade to a warning when the profiler cannot start). New code should
+    use ``obs.tracing.trace_capture`` directly — it composes with the host
+    span tracer and the ``--trace-out`` Chrome trace export."""
+    import warnings
 
-    Degrades to a warning when the profiler cannot start (an exotic backend
-    without profiler support): a broken ``--profile`` flag must not kill the
-    measurement run it was meant to observe. Verified working on the tunneled
-    TPU plugin — per-op device time includes the attention kernel, the
-    ``ppermute`` hops, and the Pallas codec kernels."""
-    with contextlib.ExitStack() as stack:
-        try:
-            stack.enter_context(jax.profiler.trace(log_dir))
-        except RuntimeError as e:
-            import warnings
+    from ..obs.tracing import trace_capture
 
-            warnings.warn(f"XLA profiler unavailable on this backend ({e}); "
-                          f"continuing without a trace")
+    warnings.warn("utils.profiling.trace is deprecated; use "
+                  "edgellm_tpu.obs.tracing.trace_capture",
+                  DeprecationWarning, stacklevel=3)
+    with trace_capture(log_dir):
         yield
 
 

@@ -65,15 +65,27 @@ def test_bench_decode_headline(monkeypatch, capsys, tmp_path):
     assert len(out[-1]) < 1024
     assert set(line) <= {"metric", "value", "unit", "vs_baseline",
                          "tokens_per_s", "prefill_s", "batch",
-                         "decode_step_cache_misses"}
+                         "decode_step_cache_misses", "ttft_s",
+                         "token_latency_p50_s", "token_latency_p95_s",
+                         "token_latency_p99_s"}
+    # the SLO acceptance surface: TTFT + per-token p50/p95/p99 in the artifact
+    assert line["ttft_s"] > 0
+    assert 0 < line["token_latency_p50_s"] <= line["token_latency_p95_s"]
+    assert line["token_latency_p95_s"] <= line["token_latency_p99_s"]
     detail = json.loads(out[-2])["detail"]
     dec = detail["decode"]
     assert dec["prompt"] == 8 and dec["batch"] == 2
     assert dec["split_hop_bytes_per_token"] > 0
+    assert dec["obs_overhead_frac"] >= 0  # instrumented-vs-clean delta
+    assert dec["slo"]["token_latency_p50_s"] > 0
     # conftest spoofs 8 CPU devices, so the split section must have run
     assert dec["split"]["tokens_per_s"] > 0
     assert dec["split"]["hop_bytes_per_token"] == [
         b / 2 for b in dec["split"]["measured_hop_bytes_per_step"]]
+    # the meta provenance block is stamped centrally on every artifact
+    meta = detail["meta"]
+    assert meta["schema_version"] == bench.BENCH_SCHEMA_VERSION
+    assert meta["jax_version"] and meta["backend"] == "cpu"
     assert json.load(open(tmp_path / "detail.json")) == detail
 
 
@@ -113,6 +125,53 @@ def test_bench_fec_headline(monkeypatch, capsys, tmp_path):
     # the decode leg ran (8 spoofed devices) with all three link builds
     assert {"clean", "faulty_retry_only", "faulty_fec"} <= set(fec["decode"])
     assert json.load(open(tmp_path / "detail.json")) == detail
+
+
+def test_bench_obs_headline(monkeypatch, capsys, tmp_path):
+    """BENCH_OBS=1: the observability smoke arms the full obs stack, runs an
+    instrumented decode, and writes the two promised artifacts — a metrics
+    snapshot and a Perfetto-loadable Chrome trace — while the detail sidecar
+    carries the registry snapshot via _emit's enabled-registry hook."""
+    sys.modules.pop("bench", None)
+    import bench
+    from edgellm_tpu import obs
+
+    metrics_path = tmp_path / "metrics.json"
+    trace_path = tmp_path / "trace.json"
+    monkeypatch.setenv("BENCH_DETAIL_PATH", str(tmp_path / "detail.json"))
+    monkeypatch.setenv("BENCH_OBS", "1")
+    monkeypatch.setenv("BENCH_MODEL", "tiny-qwen2")
+    monkeypatch.setenv("BENCH_DTYPE", "float32")
+    monkeypatch.setenv("BENCH_OBS_PROMPT", "8")
+    monkeypatch.setenv("BENCH_OBS_TOKENS", "8")
+    monkeypatch.setenv("BENCH_OBS_BATCH", "2")
+    monkeypatch.setenv("BENCH_OBS_METRICS_PATH", str(metrics_path))
+    monkeypatch.setenv("BENCH_OBS_TRACE_PATH", str(trace_path))
+    try:
+        bench.main()
+    finally:
+        obs.disable()  # never leak an armed registry into other tests
+    assert not obs.enabled()  # obs_main's own finally already disarmed it
+    out = capsys.readouterr().out.strip().splitlines()
+    line = json.loads(out[-1])
+    assert line["unit"] == "decode tokens/s (obs on)" and line["value"] > 0
+    assert line["n_metrics"] > 0 and line["n_spans"] > 0
+    assert line["ttft_s"] > 0 and line["token_latency_p99_s"] > 0
+    assert len(out[-1]) < 1024
+    detail = json.loads(out[-2])["detail"]
+    # _emit folded the enabled registry's snapshot into the sidecar
+    assert "edgellm_decode_steps_total" in detail["metrics"]
+    assert "edgellm_decode_ttft_seconds" in detail["metrics"]
+    assert detail["obs"]["split"]["decode_tokens_per_s"] > 0
+    # the on-disk artifacts: JSON snapshot + valid Chrome trace-event JSON
+    snap = json.load(open(metrics_path))
+    assert "edgellm_decode_token_latency_seconds" in snap
+    trace = json.load(open(trace_path))
+    assert trace["traceEvents"], "trace must contain spans"
+    ev = trace["traceEvents"][0]
+    assert ev["ph"] == "X" and {"name", "ts", "dur", "pid", "tid"} <= set(ev)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "generate.decode_loop" in names
 
 
 def test_bench_backend_outage_emits_status_artifact(monkeypatch, capsys,

@@ -539,8 +539,11 @@ def test_fault_report_prints_counters_and_health(capsys):
                         "observations": 12, "switches": 2, "window": 2},
     })
     out = capsys.readouterr().out
-    assert "detected" in out and "repaired" in out and "hedge_wins" in out
-    assert "hop0" in out and "hop1" in out and "total" in out
-    assert "burn" in out
+    # one unified obs-registry table: per-hop counters, totals, health gauges
+    assert "edgellm_link_detected_total" in out
+    assert "edgellm_link_repaired_total" in out
+    assert "edgellm_link_hedge_wins_total" in out
+    assert 'hop="0"' in out and 'hop="1"' in out and 'hop="total"' in out
+    assert "edgellm_link_health_burn_rate" in out
     _print_fault_report({})
     assert "no link counters" in capsys.readouterr().out

@@ -1,0 +1,466 @@
+"""Unified telemetry (obs/) coverage: histogram quantiles vs numpy, span
+nesting/threading and Chrome trace-event schema, Prometheus round-trip,
+adapter parity with the legacy counter dicts, the CounterSource protocol,
+and the zero-residue guarantees — obs disabled (the default) must trace
+byte-identical jaxprs, and enabled instrumentation must not change the
+sampled tokens. The ≤3% decode-overhead budget rides the slow marker (the
+same number BENCH_DECODE=1 records as ``obs_overhead_frac``)."""
+import json
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from edgellm_tpu import obs
+from edgellm_tpu.obs import metrics as obs_metrics
+from edgellm_tpu.obs.latency import LatencyObserver
+from edgellm_tpu.obs.metrics import (Counter, CounterSource, Gauge, Histogram,
+                                     MetricsRegistry, format_table,
+                                     record_decode_stats, record_link_counters,
+                                     record_link_health,
+                                     record_recovery_counters,
+                                     record_wire_bytes)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Never leak an armed registry/tracer (process-global) across tests."""
+    yield
+    obs.disable()
+    obs.get_registry().clear()
+    obs.get_tracer().clear()
+
+
+# ---------------------------------------------------------------------------
+# metrics primitives
+# ---------------------------------------------------------------------------
+
+
+def test_counter_and_gauge_basics():
+    c = Counter("c", "help")
+    c.inc()
+    c.inc(2.5, hop=0)
+    assert c.value() == 1.0
+    assert c.value(hop=0) == 2.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = Gauge("g")
+    g.set(3.0)
+    g.inc(-1.5)
+    assert g.value() == 1.5  # gauges go both ways
+
+
+def test_histogram_quantiles_match_numpy():
+    """Interpolated p50/p95/p99 within one bucket's relative width of
+    numpy's linear-interpolation percentiles on a latency-shaped sample."""
+    rng = np.random.default_rng(0)
+    xs = rng.lognormal(mean=-4.0, sigma=1.0, size=20_000)  # ~18ms median
+    h = Histogram("h", lo=1e-5, hi=1e2, n_buckets=480)
+    for x in xs:
+        h.observe(float(x))
+    bucket_width = (1e2 / 1e-5) ** (1.0 / 480) - 1.0  # ~3.4% relative
+    for q in (0.50, 0.95, 0.99):
+        got = h.quantile(q)
+        want = float(np.percentile(xs, q * 100))
+        assert abs(got - want) / want < 1.5 * bucket_width, (q, got, want)
+    p = h.percentiles()
+    assert p["count"] == 20_000
+    assert p["min"] == xs.min() and p["max"] == xs.max()
+    np.testing.assert_allclose(p["mean"], xs.mean(), rtol=1e-9)
+
+
+def test_histogram_bounds_and_edge_cases():
+    h = Histogram("h", lo=1e-3, hi=1.0, n_buckets=8)
+    assert math.isnan(h.quantile(0.5))  # empty
+    for v in (1e-6, 0.5, 100.0):  # underflow, in-range, overflow
+        h.observe(v)
+    # quantiles stay inside the observed extremes despite coarse buckets
+    for q in (0.0, 0.5, 1.0):
+        assert 1e-6 <= h.quantile(q) <= 100.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+    with pytest.raises(ValueError):
+        Histogram("bad", lo=1.0, hi=0.5)
+
+
+def test_histogram_merge_from():
+    a = Histogram("h", lo=1e-3, hi=1.0, n_buckets=32)
+    b = Histogram("h", lo=1e-3, hi=1.0, n_buckets=32)
+    for v in (0.01, 0.02):
+        a.observe(v)
+    for v in (0.2, 0.4, 0.8):
+        b.observe(v)
+    a.merge_from(b)
+    assert a.count == 5
+    np.testing.assert_allclose(a.sum, 0.01 + 0.02 + 0.2 + 0.4 + 0.8)
+    assert a.percentiles()["max"] == 0.8
+    with pytest.raises(ValueError):
+        a.merge_from(Histogram("h", lo=1e-3, hi=1.0, n_buckets=16))
+
+
+def test_registry_get_or_create_and_kind_mismatch():
+    reg = MetricsRegistry(enabled=True)
+    c1 = reg.counter("x_total")
+    assert reg.counter("x_total") is c1  # get-or-create, never re-registered
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")
+    assert reg.names() == ["x_total"]
+    reg.clear()
+    assert reg.names() == []
+
+
+def test_prometheus_text_format_round_trip():
+    """Every sample line of the exposition parses back to the registry's
+    value; histogram bucket series are cumulative and consistent."""
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("edgellm_x_total", "a counter").inc(3, hop=1)
+    reg.gauge("edgellm_g", "a gauge").set(2.5)
+    h = reg.histogram("edgellm_h", "a histogram", lo=1e-3, hi=1.0,
+                      n_buckets=16)
+    for v in (0.01, 0.1, 0.5):
+        h.observe(v)
+    text = reg.to_prometheus()
+    assert "# HELP edgellm_x_total a counter" in text
+    assert "# TYPE edgellm_h histogram" in text
+    assert 'edgellm_x_total{hop="1"} 3.0' in text
+    samples = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line:
+            continue
+        name_labels, val = line.rsplit(" ", 1)
+        samples[name_labels] = float(val)
+    assert samples['edgellm_x_total{hop="1"}'] == 3.0
+    assert samples["edgellm_g"] == 2.5
+    assert samples["edgellm_h_count"] == 3
+    np.testing.assert_allclose(samples["edgellm_h_sum"], 0.61)
+    buckets = [(k, v) for k, v in samples.items()
+               if k.startswith("edgellm_h_bucket")]
+    cums = [v for _, v in buckets]
+    assert cums == sorted(cums)  # cumulative le-series never decreases
+    assert any(k.endswith('le="+Inf"}') and v == 3 for k, v in buckets)
+    # the JSON exporter round-trips through json.loads
+    snap = json.loads(reg.to_json())
+    assert snap["edgellm_h"]["kind"] == "histogram"
+    assert snap["edgellm_x_total"]["values"]['{hop="1"}'] == 3.0
+
+
+def test_format_table_renders_all_kinds():
+    reg = MetricsRegistry(enabled=True)
+    reg.counter("edgellm_x_total").inc(2, hop=0)
+    reg.histogram("edgellm_lat", lo=1e-3, hi=1.0, n_buckets=8).observe(0.1)
+    out = format_table(reg, title="t")
+    assert out.startswith("t:")
+    assert 'edgellm_x_total{hop="0"}' in out
+    assert "edgellm_lat.p99" in out
+    assert format_table(MetricsRegistry(), title="e") == "e: (empty)"
+
+
+# ---------------------------------------------------------------------------
+# adapters: registry values == the legacy dict shapes
+# ---------------------------------------------------------------------------
+
+
+def test_adapter_parity_link_counters():
+    delta = {"detected": np.array([2, 0]), "repaired": [1, 3]}
+    reg = MetricsRegistry(enabled=True)
+    record_link_counters(delta, registry=reg)
+    c = reg.get("edgellm_link_detected_total")
+    assert c.value(hop=0) == 2 and c.value(hop=1) == 0  # zero hops skipped
+    r = reg.get("edgellm_link_repaired_total")
+    assert r.value(hop=0) == 1 and r.value(hop=1) == 3
+    # the registry totals match the legacy dict exactly
+    for key, per_hop in delta.items():
+        got = sum(v for _, v in reg.get(f"edgellm_link_{key}_total").items())
+        assert got == sum(int(x) for x in per_hop)
+    # disabled registry records nothing at all
+    off = MetricsRegistry(enabled=False)
+    record_link_counters(delta, registry=off)
+    assert off.names() == []
+
+
+def test_adapter_parity_recovery_health_decode_wire():
+    from edgellm_tpu.serve.recovery import RecoveryCounters
+
+    reg = MetricsRegistry(enabled=True)
+    rc = RecoveryCounters(failovers=1, checkpoints_written=4)
+    record_recovery_counters(rc, registry=reg)
+    assert reg.get("edgellm_recovery_failovers_total").value() == 1
+    assert reg.get("edgellm_recovery_checkpoints_written_total").value() == 4
+    assert reg.get("edgellm_recovery_replans_total") is None  # zeros skipped
+
+    health = {"tier": 1, "burn_rate": 0.25, "corruption_rate": 0.01,
+              "window": 128, "note": "not-a-number"}
+    record_link_health(health, registry=reg)
+    assert reg.get("edgellm_link_health_burn_rate").value() == 0.25
+    assert reg.get("edgellm_link_health_tier").value() == 1
+    assert reg.get("edgellm_link_health_note") is None  # non-numeric skipped
+
+    record_decode_stats({"decode_step_cache_misses": 2, "decode_steps": 63,
+                         "prefill_s": 0.5, "decode_s": 1.25}, registry=reg)
+    assert reg.get("edgellm_decode_jit_cache_misses_total").value() == 2
+    assert reg.get("edgellm_decode_steps_total").value() == 63
+    assert reg.get("edgellm_decode_decode_s").value() == 1.25
+
+    record_wire_bytes([100.0, 50.0], kind="decode", steps=10, registry=reg)
+    w = reg.get("edgellm_wire_bytes_total")
+    assert w.value(hop=0, kind="decode") == 1000.0
+    assert w.value(hop=1, kind="decode") == 500.0
+
+
+def test_counter_source_protocol_covers_all_runtimes():
+    """The typed replacement for hasattr(rt, "link_counters"): every decode
+    runtime satisfies the protocol structurally (no inheritance)."""
+    from edgellm_tpu.parallel.ring import SplitRingRuntime
+    from edgellm_tpu.parallel.split import SplitRuntime
+    from edgellm_tpu.serve.recovery import LocalRuntime
+
+    for cls in (SplitRuntime, SplitRingRuntime, LocalRuntime):
+        assert isinstance(cls.__new__(cls), CounterSource), cls
+    assert not isinstance(object(), CounterSource)
+
+
+# ---------------------------------------------------------------------------
+# tracing
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_ordering_and_threads():
+    obs.enable(obs.ObservabilityConfig(metrics=False, tracing=True,
+                                       latency=False))
+    tracer = obs.get_tracer()
+    tracer.clear()
+
+    def work(tag):
+        with obs.span(f"outer.{tag}", tag=tag):
+            with obs.span(f"inner.{tag}"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    with obs.span("main.solo"):
+        pass
+    spans = {s.name: s for s in tracer.spans()}
+    assert len(spans) == 9
+    for i in range(4):
+        outer, inner = spans[f"outer.{i}"], spans[f"inner.{i}"]
+        assert outer.tid == inner.tid  # per-thread lanes
+        assert outer.ts_us <= inner.ts_us  # child opens inside parent
+        assert outer.dur_us >= inner.dur_us  # and closes inside it
+        assert outer.args["tag"] == i
+    assert spans["main.solo"].tid != spans["outer.0"].tid
+
+
+def test_chrome_trace_schema_and_export(tmp_path):
+    obs.enable(obs.ObservabilityConfig(metrics=False, tracing=True,
+                                       latency=False))
+    tracer = obs.get_tracer()
+    tracer.clear()
+    with obs.span("a", shape=(2, 3), n=7):  # non-primitive arg -> repr
+        with obs.span("b"):
+            pass
+    path = tmp_path / "trace.json"
+    tracer.export(str(path))
+    trace = json.load(open(path))
+    assert set(trace) == {"traceEvents", "displayTimeUnit"}
+    assert trace["displayTimeUnit"] == "ms"
+    for ev in trace["traceEvents"]:
+        assert ev["ph"] == "X"
+        assert {"name", "ts", "dur", "pid", "tid"} <= set(ev)
+        assert isinstance(ev["ts"], (int, float)) and ev["dur"] >= 0
+    ev_a = next(e for e in trace["traceEvents"] if e["name"] == "a")
+    assert ev_a["args"] == {"shape": "(2, 3)", "n": 7}
+    # events come out (tid, ts)-sorted — stable lanes in Perfetto
+    keys = [(e["tid"], e["ts"]) for e in trace["traceEvents"]]
+    assert keys == sorted(keys)
+
+
+def test_span_disabled_is_free_and_records_nothing():
+    assert not obs.enabled()
+    tracer = obs.get_tracer()
+    tracer.clear()
+    cm1, cm2 = obs.span("x"), obs.span("y", k=1)
+    assert cm1 is cm2  # the shared nullcontext: zero allocation per call
+    with cm1 as s:
+        assert s is None
+    assert tracer.spans() == []
+
+
+def test_trace_capture_shim_and_deprecation(tmp_path):
+    """utils.profiling.trace delegates (with a DeprecationWarning) to
+    obs.tracing.trace_capture, which degrades to a warning — never a crash —
+    when the profiler can't start."""
+    from edgellm_tpu.utils import profiling
+
+    with pytest.deprecated_call():
+        with profiling.trace(str(tmp_path / "xla")):
+            pass
+    # double-start degrades: the second capture warns instead of raising
+    from edgellm_tpu.obs.tracing import trace_capture
+
+    with trace_capture(str(tmp_path / "a")):
+        with trace_capture(str(tmp_path / "b")):
+            pass
+
+
+# ---------------------------------------------------------------------------
+# latency + decode integration: zero residue, identical tokens
+# ---------------------------------------------------------------------------
+
+
+def _tiny_setup():
+    import jax
+    from edgellm_tpu.models import init_params, tiny_config
+
+    cfg = tiny_config("qwen2", num_layers=2, hidden_size=32, num_heads=4,
+                      vocab_size=64)
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    ids = np.asarray(rng.integers(0, cfg.vocab_size, (2, 4)))
+    return cfg, params, ids
+
+
+def test_latency_observer_summary_and_publish():
+    obs.enable(obs.ObservabilityConfig())
+    lat = LatencyObserver()
+    lat.start()
+    lat.first_token(np.zeros(2))
+    for _ in range(8):
+        lat.token(np.zeros(2))
+    s = lat.summary()
+    assert {"ttft_s", "ttft_p50_s", "token_latency_p50_s",
+            "token_latency_p95_s", "token_latency_p99_s",
+            "token_latency_mean_s", "tokens_per_s_observed"} <= set(s)
+    assert s["token_latency_p50_s"] <= s["token_latency_p99_s"]
+    lat.publish()
+    reg = obs.get_registry()
+    assert reg.get("edgellm_decode_ttft_seconds").count == 1
+    assert reg.get("edgellm_decode_token_latency_seconds").count == 8
+
+
+def test_generate_tokens_identical_with_and_without_observe():
+    import jax.numpy as jnp
+    from edgellm_tpu.serve.decode import generate
+
+    cfg, params, ids = _tiny_setup()
+    ids = jnp.asarray(ids)
+    plain = generate(cfg, params, ids, 6, capacity=12)
+    obs.enable(obs.ObservabilityConfig())
+    st: dict = {}
+    observed = generate(cfg, params, ids, 6, capacity=12, stats=st,
+                        observe=LatencyObserver())
+    np.testing.assert_array_equal(np.asarray(plain), np.asarray(observed))
+    # the stats dict gains the SLO block and the registry absorbed it
+    assert st["ttft_s"] > 0 and st["token_latency_p50_s"] > 0
+    assert obs.get_registry().get("edgellm_decode_steps_total").value() == 5
+
+
+def test_obs_enabled_traces_identical_jaxpr():
+    """The graphlint identity contract at unit scale: arming the full obs
+    stack (registry + tracer + an open span) must not change one byte of the
+    decode-step jaxpr — all instrumentation is host-side."""
+    import jax
+    from edgellm_tpu.lint.contracts import graph_fingerprint
+    from edgellm_tpu.models import transformer
+
+    cfg, params, ids = _tiny_setup()
+    cache = transformer.init_cache(cfg, 2, 8)
+    tok = np.zeros((2,), np.int32)
+
+    def step(p, c, t):
+        return transformer.decode_step(cfg, p, c, t)
+
+    args = (params, cache, jax.numpy.asarray(tok))
+    fp_off = graph_fingerprint(step, *args)
+    obs.enable(obs.ObservabilityConfig())
+    with obs.span("probe"):
+        fp_on = graph_fingerprint(step, *args)
+    assert fp_on == fp_off
+
+
+@pytest.mark.slow
+def test_decode_observe_overhead_within_budget():
+    """The 3% SLO: instrumented decode (block at sample boundaries only)
+    must stay within 3% tok/s of uninstrumented — the same number
+    BENCH_DECODE=1 records as ``obs_overhead_frac``. Best-of-N on both arms
+    to shed scheduler noise."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import init_params, tiny_config
+    from edgellm_tpu.serve.decode import generate
+
+    # big enough that a per-step compute dwarfs the one host sync per sampled
+    # token; at toy widths (32) the sync itself dominates and the 3% budget
+    # is meaningless
+    cfg = tiny_config("qwen2", num_layers=4, hidden_size=128, num_heads=4,
+                      vocab_size=256)
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 16)))
+    new_tokens, capacity, n = 64, 80, 5
+    generate(cfg, params, ids, new_tokens, capacity=capacity)  # compile
+
+    def best(observe_factory):
+        rates = []
+        for _ in range(n):
+            st: dict = {}
+            generate(cfg, params, ids, new_tokens, capacity=capacity,
+                     stats=st, observe=observe_factory())
+            rates.append(st["decode_tokens_per_s"])
+        return max(rates)
+
+    plain = best(lambda: None)
+    instrumented = best(lambda: LatencyObserver())
+    overhead = 1.0 - instrumented / plain
+    assert overhead <= 0.03, f"obs decode overhead {overhead:.2%} > 3%"
+
+
+# ---------------------------------------------------------------------------
+# run.py wiring
+# ---------------------------------------------------------------------------
+
+
+def test_run_params_observability_validation(tmp_path):
+    from edgellm_tpu.run import main
+
+    def run_with(ob):
+        p = tmp_path / "params.json"
+        p.write_text(json.dumps({"observability": ob}))
+        main(["--params", str(p), "--model", "tiny-qwen2"])
+
+    with pytest.raises(SystemExit, match="observability.metrics must be"):
+        run_with({"metrics": "yes"})
+    with pytest.raises(SystemExit, match="unknown field"):
+        run_with({"metricz": True})
+    with pytest.raises(SystemExit, match="must be an object"):
+        run_with(True)
+
+
+def test_run_metrics_and_trace_out_split_e2e(tmp_path):
+    """--metrics-out/--trace-out end to end on the split eval (smoke mode):
+    the snapshot carries the wire-byte counters, the trace carries the eval
+    section spans, and a .prom path switches to Prometheus text format."""
+    from edgellm_tpu.run import main
+
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({
+        "experiment": "split", "cuts": [1],
+        "hop_codecs": ["int8_per_token"], "max_length": 32, "stride": 16}))
+    mpath, tpath = tmp_path / "metrics.json", tmp_path / "trace.json"
+    try:
+        assert main(["--params", str(p), "--model", "tiny-qwen2",
+                     "--output-dir", str(tmp_path / "out"),
+                     "--max-chunks", "2", "--window-batch", "2",
+                     "--synthetic-corpus-len", "256",
+                     "--metrics-out", str(mpath),
+                     "--trace-out", str(tpath)]) in (0, None)
+    finally:
+        obs.disable()
+    snap = json.load(open(mpath))
+    assert "edgellm_wire_bytes_total" in snap
+    trace = json.load(open(tpath))
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "eval.submit_group" in names and "eval.drain_group" in names
