@@ -83,6 +83,21 @@ text format) and a Perfetto-loadable Chrome trace to BENCH_OBS_TRACE_PATH
 BENCH_OBS_TOKENS (default 32), BENCH_OBS_BATCH (default 2), plus the shared
 BENCH_MODEL / BENCH_DTYPE.
 
+BENCH_SOAK=1 switches to the deterministic chaos soak over the serving
+front (see ``soak_main``): seeded Poisson open-loop arrivals pushed through
+a ServeFront on a virtual clock, a mid-soak stage kill and a
+link-corruption burst fired by arrival index, and an artifact reporting
+goodput tokens/s, SLO attainment, reject/shed rates, p99 TTFT, post-kill
+recovery time, retry-budget accounting, and the bit-identity audit of every
+completed request against a fault-free reference. Knobs:
+BENCH_SOAK_REQUESTS (default 24), BENCH_SOAK_RATE (virtual arrivals/s,
+default 0.5 — below the tiny-model service rate so the burst window spans
+served requests; raise above the service rate to drive overload),
+BENCH_SOAK_PROMPT (default 8), BENCH_SOAK_TOKENS (default 8),
+BENCH_SOAK_DEADLINE_S (virtual-seconds deadline per request, default 60),
+BENCH_SOAK_CORRUPT (burst-window per-attempt drop rate, default 0.2),
+BENCH_SOAK_SEED, plus the shared BENCH_MODEL / BENCH_DTYPE.
+
 Every artifact (headline sidecar) carries a ``meta`` provenance block —
 schema_version, git commit, jax/jaxlib versions, backend, UTC timestamp —
 attached centrally in ``_emit``; readers must tolerate its absence in
@@ -821,6 +836,132 @@ def obs_main():
         obs.disable()
 
 
+def soak_main():
+    """BENCH_SOAK=1: deterministic chaos soak over the serving front.
+
+    Builds a :class:`ServeFront` on a virtual clock over the real split
+    runtime (3 stages when >= 3 devices are visible, 2 with 2, local-only
+    below that), with a low ambient drop rate on the boundary wire, then
+    runs :func:`run_soak`: seeded Poisson arrivals, a whole-stage kill at
+    the midpoint arrival, and a corruption-burst runtime (same topology,
+    BENCH_SOAK_CORRUPT per-attempt drop rate) swapped in over the burst
+    arrival window. The headline is goodput tokens/s over the virtual span;
+    SLO attainment, reject/shed rates, p99 TTFT, post-kill recovery time,
+    the retry-budget audit, and the completed-request token-identity audit
+    ride alongside (the last two are pass/fail acceptance surfaces). The
+    full soak artifact goes to the detail sidecar."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.codecs.faults import FaultConfig, LinkPolicy
+    from edgellm_tpu.serve.frontend import ServeFront
+    from edgellm_tpu.serve.soak import SoakConfig, run_soak
+    from edgellm_tpu.utils.clock import FakeClock
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    n_requests = int(os.environ.get("BENCH_SOAK_REQUESTS", "24"))
+    # default arrival rate sits below the tiny models' ~0.6 req/s service
+    # rate so arrivals interleave with drains and the burst window spans
+    # actually-served requests; push it above service rate to drive the
+    # overload (backlog/brownout/reject) regime instead
+    rate = float(os.environ.get("BENCH_SOAK_RATE", "0.5"))
+    prompt_len = int(os.environ.get("BENCH_SOAK_PROMPT", "8"))
+    new_tokens = int(os.environ.get("BENCH_SOAK_TOKENS", "8"))
+    deadline_s = float(os.environ.get("BENCH_SOAK_DEADLINE_S", "60"))
+    corrupt = float(os.environ.get("BENCH_SOAK_CORRUPT", "0.2"))
+    seed = int(os.environ.get("BENCH_SOAK_SEED", "0"))
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    n_dev = len(jax.devices())
+    clock = FakeClock()
+
+    # the boundary wire: a low ambient per-ATTEMPT drop rate that the
+    # unrolled retries recover (drop, unlike per-byte bitflips, gives each
+    # retry an independent 1-rate success chance — the regime where retries
+    # work and completed stays token-identical), bursting to BENCH_SOAK_CORRUPT
+    # over the burst window
+    policy = LinkPolicy(max_retries=4)
+    ambient = FaultConfig(drop_rate=0.02, seed=seed)
+    burst_fc = FaultConfig(drop_rate=corrupt, seed=seed)
+
+    burst_rt = None
+    kill_stage = None
+    if n_dev >= 2:
+        from edgellm_tpu.parallel.split import (SplitConfig, SplitRuntime,
+                                                make_stage_mesh)
+
+        n_stages = 3 if n_dev >= 3 else 2
+        cuts = tuple(round(i * cfg.num_layers / n_stages) - 1
+                     for i in range(1, n_stages))
+        split = SplitConfig(cuts=cuts,
+                            hop_codecs=("int8_per_token",) * len(cuts))
+        mesh = make_stage_mesh(n_stages)
+        rt = SplitRuntime(cfg, split, mesh, faults=ambient, policy=policy)
+        burst_rt = SplitRuntime(cfg, split, mesh, faults=burst_fc,
+                                policy=policy)
+        # with 3+ stages the kill exercises the front's replan-onto-survivors
+        # failover; with exactly 2 it exercises the local-fallback route
+        kill_stage = 1
+        front = ServeFront(cfg, params, split_runtime=rt,
+                           compute_dtype=dtype, clock=clock)
+    else:
+        front = ServeFront(cfg, params, compute_dtype=dtype, clock=clock)
+
+    # pre-warm the jit caches for every route the soak can take (ambient
+    # split, burst split, local fallback): the first request's service time
+    # advances the VIRTUAL clock, so an uncompiled path would fold ~tens of
+    # compile-seconds into the timeline and collapse all later arrivals
+    # (and the burst window) into one instant
+    from edgellm_tpu.serve.decode import generate, generate_split
+
+    capacity = -(-(prompt_len + new_tokens) // 16) * 16
+    warm_ids = jnp.asarray(
+        np.zeros((1, prompt_len), np.int32))
+    warm_kw = dict(capacity=capacity, temperature=0.7,
+                   rng_key=jax.random.key(0))
+    generate(cfg, params, warm_ids, new_tokens, compute_dtype=dtype,
+             **warm_kw)
+    if n_dev >= 2:
+        for wrt in (rt, burst_rt):
+            generate_split(wrt, wrt.place_params(params), warm_ids,
+                           new_tokens, **warm_kw)
+
+    soak = SoakConfig(
+        n_requests=n_requests, arrival_rate=rate, seed=seed,
+        prompt_len=prompt_len, max_new_tokens=new_tokens,
+        deadline_s=deadline_s, kill_stage=kill_stage)
+    artifact = run_soak(front, soak, clock=clock, burst_runtime=burst_rt)
+
+    detail = {"soak": artifact, "devices": n_dev,
+              "ambient_drop_rate": 0.02, "burst_drop_rate": corrupt,
+              "retries": policy.max_retries}
+    outcomes = artifact["outcomes"]
+    identity = artifact["token_identity"]
+    kill = artifact["kill"]
+    line = {
+        "metric": (f"{model_name} chaos-soak goodput ({n_requests} reqs at "
+                   f"{rate}/s virtual, stage kill"
+                   + (f" @{kill_stage}" if kill_stage is not None else " off")
+                   + f", burst drop {corrupt})"),
+        "value": round(artifact["goodput_tokens_per_s"], 2),
+        "unit": "goodput tokens/s (virtual)",
+        "vs_baseline": None,  # the reference has no serving layer at all
+        "completed": outcomes.get("completed", 0),
+        "failed_over": outcomes.get("failed_over", 0),
+        "slo_attainment": artifact["slo_attainment"],
+        "reject_rate": round(artifact["reject_rate"], 4),
+        "shed_rate": round(artifact["shed_rate"], 4),
+        "p99_ttft_s": artifact["p99_ttft_s"],
+        "recovery_s": None if kill is None else kill["recovery_s"],
+        "retry_budget_ok": artifact["retry_budget"]["within_budget"],
+        "token_identity_ok": None if identity is None else identity["ok"],
+    }
+    _emit(line, detail)
+
+
 def _backend_unavailable(exc: BaseException) -> bool:
     """True when the error is an accelerator-backend outage (the tunneled
     TPU plugin failing to come up), not a code bug in the bench."""
@@ -875,6 +1016,8 @@ def main():
         return _run_section("faults", faults_main)
     if os.environ.get("BENCH_FEC") == "1":
         return _run_section("fec", fec_main)
+    if os.environ.get("BENCH_SOAK") == "1":
+        return _run_section("soak", soak_main)
     return _run_section("sweep", sweep_main)
 
 

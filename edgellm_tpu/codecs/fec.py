@@ -40,15 +40,15 @@ and a graphlint fingerprint contract asserts it.
 from __future__ import annotations
 
 import dataclasses
-import time
 from collections import deque
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from ..lint import graph_contract
+from ..utils.clock import MONOTONIC, Clock
 from .faults import (_CRC_MULT, _bump, inject_faults, seal_payload,
                      tree_nbytes, verify_payload)
 
@@ -373,7 +373,7 @@ class LinkHealth:
 
     def __init__(self, n_tiers: int = 1,
                  config: Optional[LinkHealthConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Clock = MONOTONIC):
         if n_tiers < 1:
             raise ValueError("need at least one tier")
         self.cfg = config if config is not None else LinkHealthConfig()

@@ -38,6 +38,7 @@ from ..codecs.fec import FECConfig, HedgeConfig, LinkHealth, LinkHealthConfig
 from ..obs.metrics import (record_link_counters, record_link_health,
                            record_recovery_counters, record_wire_bytes)
 from ..obs.tracing import span as obs_span
+from ..utils.clock import MONOTONIC
 from ..serve.recovery import (DecodeTimeout, RecoveryCounters, StageFailure,
                               StageLostError, Watchdog)
 from .harness import (ResumableDriver, _emit, _iter_window_groups,
@@ -125,7 +126,7 @@ def run_split_eval(
     deadline_s: Optional[float] = None,
     stage_failure: Optional[object] = None,
     recovery: Optional[dict] = None,
-    _clock=time.monotonic,
+    _clock=MONOTONIC,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
 

@@ -134,6 +134,33 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     (findings.extend(ident) if ident
      else checked.append("decode.recovery-identity"))
 
+    # the serving front must add NOTHING either: a default-config ServeFront
+    # routes admitted requests through the direct generate() loop, so the
+    # decode step it traces — with the front's own bucketed capacity and
+    # static args — is byte-identical to calling generate directly
+    from ..serve.frontend import ServeFront
+
+    front = ServeFront(cfg, params)
+    spec = front.step_trace_spec(BATCH, SEQ, max_new_tokens=CAPACITY - SEQ)
+    if spec["uses_survivable_loop"]:
+        findings.append(Finding(
+            layer="graph", rule="GC-identity",
+            where="frontend.decode-step-identity", line=0,
+            message="default-config ServeFront routes decode through the "
+                    "survivable loop instead of the direct generate path"))
+    front_cache = transformer.init_cache(cfg, BATCH, spec["capacity"])
+    ident = check_identity(
+        "frontend.decode-step-identity",
+        lambda p, c, t, k: serve_decode._step_impl(
+            cfg, p, c, t, k, spec["temperature"], spec["compute_dtype"]),
+        (params, front_cache, tok, key),
+        lambda p, c, t, k: serve_decode._step_impl(cfg, p, c, t, k, 0.0,
+                                                   None),
+        (params, front_cache, tok, key),
+        what="default-config ServeFront decode-step graph")
+    (findings.extend(ident) if ident
+     else checked.append("frontend.decode-step-identity"))
+
     # ---- split pipeline: boundary hops over a real 2-stage mesh ---------
     if len(jax.devices()) < 2:
         skipped.append("split/fault contracts: needs >= 2 devices "

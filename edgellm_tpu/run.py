@@ -15,6 +15,9 @@ Dispatch mirrors the reference:
 - ``experiment: "split"``     -> real mesh-split eval (ppermute boundary hops)
 - ``experiment: "distances"`` -> layer-pair JS-divergence matrix + heatmap
   (the ``distributions_distance_across_layers.ipynb`` cell 16-18 analysis)
+- ``experiment: "serve"``     -> deterministic soak through the overload-robust
+  serving front (admission control, circuit breakers, brownout; ``"serving"``
+  params block, ``--serve-report``)
 - methods containing "channel" -> per-channel codec sweep (``main.py:118-119``)
 - otherwise                   -> the Qwen-style token sweep
 
@@ -102,16 +105,21 @@ _PARAM_KEYS = {
     "methods": "token/channel sweeps",
     "layers_of_interest": "initial/token/channel sweeps",
     "ratios": "initial/token sweeps",
-    "cuts": "split", "hop_codecs": "split", "importance_method": "split",
+    "cuts": "split/serve", "hop_codecs": "split/serve",
+    "importance_method": "split",
     "n_seq": "split", "n_data": "split", "n_model": "split",
-    "faults": "split", "link_policy": "split",
-    "fec": "split", "hedge": "split", "link_health": "split",
+    "faults": "split/serve", "link_policy": "split/serve",
+    "fec": "split/serve", "hedge": "split/serve",
+    "link_health": "split/serve",
     "deadline": "split", "stage_failure": "split", "recovery": "split",
+    "serving": "serve",
     "max_compiles": "distances",
     "observability": "all",
 }
-_EXPERIMENTS = ("", "initial", "last_row", "relevance", "split", "distances")
+_EXPERIMENTS = ("", "initial", "last_row", "relevance", "split", "distances",
+                "serve")
 _REQUIRED = {"split": ("cuts", "hop_codecs"),
+             "serve": ("serving",),
              "initial": ("layers_of_interest", "ratios")}
 
 
@@ -149,17 +157,20 @@ def _validate_params_json(p: dict) -> None:
             ObservabilityConfig(**ob)
         except (TypeError, ValueError) as e:
             die(f"observability: {e}")
-    if exp != "split" and ("faults" in p or "link_policy" in p
-                           or "fec" in p or "hedge" in p
-                           or "link_health" in p
-                           or "deadline" in p or "stage_failure" in p
+    if exp not in ("split", "serve") and (
+            "faults" in p or "link_policy" in p or "fec" in p
+            or "hedge" in p or "link_health" in p):
+        die("faults/link_policy/fec/hedge/link_health only apply to "
+            "experiments 'split' and 'serve'")
+    if exp != "split" and ("deadline" in p or "stage_failure" in p
                            or "recovery" in p):
-        die("faults/link_policy/fec/hedge/link_health/deadline/stage_failure/"
-            "recovery only apply to experiment 'split'")
+        die("deadline/stage_failure/recovery only apply to experiment 'split'")
+    if exp != "serve" and "serving" in p:
+        die("serving only applies to experiment 'serve'")
     for k in _REQUIRED.get(exp, ()):
         if k not in p:
             die(f"experiment {exp!r} requires key {k!r}")
-    if exp not in ("split", "initial", "relevance", "distances"):
+    if exp not in ("split", "serve", "initial", "relevance", "distances"):
         # token/channel sweeps (the default dispatch) sweep layers (x ratios
         # for the token sweep; the channel sweep has no ratio axis)
         methods = p.get("methods", [])
@@ -178,7 +189,9 @@ def _validate_params_json(p: dict) -> None:
     for k in ("methods", "layers_of_interest", "ratios", "cuts", "hop_codecs"):
         if k in p and not isinstance(p[k], list):
             die(f"{k} must be a list, got {type(p[k]).__name__}")
-    if exp == "split":
+    if exp == "serve" and ("cuts" in p) != ("hop_codecs" in p):
+        die("serve: cuts and hop_codecs go together")
+    if exp in ("split", "serve") and "cuts" in p:
         if not p["cuts"] or not all(
                 isinstance(c, int) and not isinstance(c, bool) and c >= 0
                 for c in p["cuts"]):
@@ -200,6 +213,7 @@ def _validate_params_json(p: dict) -> None:
                     get_wire_codec(resolved)
             except (ValueError, KeyError) as e:
                 die(f"bad hop codec {spec!r}: {e}")
+    if exp in ("split", "serve"):
         from .codecs.faults import FaultConfig, LinkPolicy
 
         for key, cls in (("faults", FaultConfig), ("link_policy", LinkPolicy)):
@@ -283,6 +297,87 @@ def _validate_params_json(p: dict) -> None:
             if isinstance(mf, bool) or not isinstance(mf, int) or mf < 1:
                 die(f"recovery.max_failovers must be a positive integer, "
                     f"got {mf!r}")
+    if "serving" in p:
+        from .serve.frontend import ServeFrontConfig
+        from .serve.overload import (AdmissionConfig, BreakerConfig,
+                                     BrownoutConfig, RetryBudgetConfig)
+        from .serve.soak import SoakConfig
+
+        sv = p["serving"]
+        if not isinstance(sv, dict):
+            die(f"serving must be an object of ServeFrontConfig fields "
+                f"(plus 'soak'), got {sv!r}")
+        top = {f.name for f in dataclasses.fields(ServeFrontConfig)} | {"soak"}
+        bad = sorted(set(sv) - top)
+        if bad:
+            die(f"serving: unknown field(s) {bad}; known: {sorted(top)}")
+        for key, cls in (("admission", AdmissionConfig),
+                         ("breaker", BreakerConfig),
+                         ("brownout", BrownoutConfig),
+                         ("retry_budget", RetryBudgetConfig),
+                         ("soak", SoakConfig)):
+            if key not in sv:
+                continue
+            if not isinstance(sv[key], dict):
+                die(f"serving.{key} must be an object of {cls.__name__} "
+                    f"fields, got {sv[key]!r}")
+            fields = {f.name for f in dataclasses.fields(cls)}
+            bad = sorted(set(sv[key]) - fields)
+            if bad:
+                die(f"serving.{key}: unknown field(s) {bad}; "
+                    f"known: {sorted(fields)}")
+            try:
+                cls(**sv[key])
+            except (TypeError, ValueError) as e:
+                die(f"serving.{key}: {e}")
+        try:
+            _serve_front_config(sv)
+        except (TypeError, ValueError) as e:
+            die(f"serving: {e}")
+        ks = (sv.get("soak") or {}).get("kill_stage")
+        if ks is not None and "cuts" in p and ks > len(p["cuts"]):
+            die(f"serving.soak.kill_stage {ks} out of range for "
+                f"{len(p['cuts']) + 1} pipeline stage(s)")
+
+
+def _serve_front_config(sv: dict):
+    """Build the :class:`ServeFrontConfig` a ``"serving"`` params block
+    describes: nested objects become the matching sub-configs, scalar keys
+    pass through, and the soak definition (``"soak"``) is the harness's,
+    not the front's. Raises ``TypeError``/``ValueError`` on bad fields —
+    the validator turns those into field-naming ``die()``s."""
+    from .serve.frontend import ServeFrontConfig
+    from .serve.overload import (AdmissionConfig, BreakerConfig,
+                                 BrownoutConfig, RetryBudgetConfig)
+
+    kwargs = {k: v for k, v in sv.items() if k != "soak"}
+    for key, cls in (("admission", AdmissionConfig),
+                     ("breaker", BreakerConfig),
+                     ("brownout", BrownoutConfig),
+                     ("retry_budget", RetryBudgetConfig)):
+        if key in kwargs:
+            kwargs[key] = cls(**kwargs[key])
+    return ServeFrontConfig(**kwargs)
+
+
+def _print_serve_report(report: dict) -> None:
+    """Human-readable tail for ``--serve-report``: outcome counts,
+    reject/shed reasons, per-breaker states, and the brownout/retry-budget
+    posture after the soak."""
+    print("serve report:")
+    for k in sorted(report["outcomes"]):
+        print(f"  outcome {k:<14} {report['outcomes'][k]}")
+    for k in sorted(report.get("reasons", {})):
+        print(f"  reason  {k:<28} {report['reasons'][k]}")
+    for name, b in sorted(report["breakers"].items()):
+        print(f"  breaker {name:<8} {b['state']:<9} opens={b['opens']} "
+              f"failures={b['total_failures']}")
+    bo = report["brownout"]
+    print(f"  brownout level={bo['level']} mode={bo['mode']} "
+          f"switches={bo['switches']} sheds={bo['sheds']}")
+    rb = report["retry_budget"]
+    print(f"  retry budget spent={rb['spent']} denied={rb['denied']} "
+          f"available={rb['available']:.1f}")
 
 
 def _print_fault_report(result: dict) -> None:
@@ -361,6 +456,10 @@ def main(argv=None) -> int:
                     help="enable host-side span tracing and write the Chrome "
                          "trace-event JSON to PATH (load at ui.perfetto.dev); "
                          "composes with --profile's XLA capture")
+    ap.add_argument("--serve-report", action="store_true",
+                    help="serve experiment: after the soak, pretty-print the "
+                         "outcome counts, reject/shed reasons, breaker "
+                         "states, and the brownout/retry-budget posture")
     ap.add_argument("--fault-report", action="store_true",
                     help="split experiment: after the sweep, pretty-print the "
                          "summed per-hop link counters (detected / repaired / "
@@ -517,6 +616,86 @@ def main(argv=None) -> int:
             print(json.dumps({"artifact": out("layer_distances.json"),
                               "heatmap": heatmap_path, "n_samples": len(samples),
                               "layers": matrix.shape[0]}))
+            return 0
+
+        if experiment == "serve":
+            import jax
+            import jax.numpy as jnp
+
+            from .serve.decode import generate, generate_split
+            from .serve.frontend import ServeFront
+            from .serve.soak import SoakConfig, run_soak
+            from .utils.clock import FakeClock
+
+            sv = params_json["serving"]
+            front_cfg = _serve_front_config(sv)
+            soak = SoakConfig(**sv.get("soak", {}))
+            clock = FakeClock()
+            rt = None
+            link_health = None
+            if "cuts" in params_json:
+                from .codecs.faults import FaultConfig, LinkPolicy
+                from .codecs.fec import (FECConfig, HedgeConfig, LinkHealth,
+                                         LinkHealthConfig)
+                from .parallel import make_stage_mesh
+                from .parallel.split import SplitConfig, SplitRuntime
+
+                n_stages = len(params_json["cuts"]) + 1
+                n_dev = len(jax.devices())
+                if n_dev < n_stages:
+                    raise SystemExit(
+                        f"experiment 'serve' with {n_stages} pipeline stages "
+                        f"needs >= {n_stages} devices, found {n_dev}")
+                lp = params_json.get("link_policy")
+                rt = SplitRuntime(
+                    cfg,
+                    SplitConfig(cuts=tuple(params_json["cuts"]),
+                                hop_codecs=tuple(params_json["hop_codecs"])),
+                    make_stage_mesh(n_stages),
+                    faults=(FaultConfig(**params_json["faults"])
+                            if "faults" in params_json else None),
+                    policy=(LinkPolicy(**{**lp,
+                                          "tiers": tuple(lp.get("tiers", ()))})
+                            if lp else None),
+                    fec=(FECConfig(**params_json["fec"])
+                         if "fec" in params_json else None),
+                    hedge=(HedgeConfig(**params_json["hedge"])
+                           if "hedge" in params_json else None))
+                if "link_health" in params_json:
+                    link_health = LinkHealth(
+                        config=LinkHealthConfig(**params_json["link_health"]),
+                        clock=clock)
+            front = ServeFront(cfg, params, split_runtime=rt,
+                               config=front_cfg, link_health=link_health,
+                               clock=clock)
+            # pre-warm the jit caches for the soak's one (batch, capacity)
+            # plan: the virtual clock advances by measured service time, and
+            # folding tens of compile-seconds into the first request would
+            # distort every arrival after it
+            cr = front_cfg.capacity_round
+            capacity = -(-(soak.prompt_len + soak.max_new_tokens) // cr) * cr
+            warm_ids = jnp.zeros((1, soak.prompt_len), jnp.int32)
+            warm_kw = dict(capacity=capacity, temperature=soak.temperature,
+                           rng_key=jax.random.key(0))
+            generate(cfg, params, warm_ids, soak.max_new_tokens, **warm_kw)
+            if rt is not None:
+                generate_split(rt, rt.place_params(params), warm_ids,
+                               soak.max_new_tokens, **warm_kw)
+            artifact = run_soak(front, soak, clock=clock)
+            with open(out("serve_report.json"), "w") as f:
+                json.dump(artifact, f, indent=1, default=float)
+            print(json.dumps({
+                "requests": artifact["requests"],
+                "outcomes": artifact["outcomes"],
+                "goodput_tokens_per_s": round(
+                    artifact["goodput_tokens_per_s"], 3),
+                "slo_attainment": artifact["slo_attainment"],
+                "p99_ttft_s": artifact["p99_ttft_s"],
+                "token_identity_ok": (artifact["token_identity"] or
+                                      {}).get("ok"),
+                "artifact": out("serve_report.json")}, default=float))
+            if args.serve_report:
+                _print_serve_report(artifact["report"])
             return 0
 
         from .eval import run_token_sweep, run_initial_sweep, run_channel_sweep

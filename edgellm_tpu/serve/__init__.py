@@ -1,12 +1,26 @@
 from .decode import (decode_step_cache_size, generate, generate_split,
                      resume_split)
+from .frontend import Request, RequestRecord, ServeFront, ServeFrontConfig
+from .overload import (AdmissionConfig, AdmissionController, AdmissionError,
+                       BreakerConfig, BrownoutConfig, BrownoutController,
+                       CircuitBreaker, CircuitOpen, DeadlineInfeasible,
+                       QueueFull, RetryBudget, RetryBudgetConfig,
+                       RetryBudgetExhausted, ServeFrontConfigError)
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        LocalRuntime, RecoveryConfig, RecoveryCounters,
                        StageFailure, StageLostError, Watchdog)
+from .soak import SoakConfig, run_soak
 
 __all__ = [
     "generate", "generate_split", "resume_split", "decode_step_cache_size",
     "CheckpointError", "DecodeCheckpoint", "DecodeTimeout", "LocalRuntime",
     "RecoveryConfig", "RecoveryCounters", "StageFailure", "StageLostError",
     "Watchdog",
+    "Request", "RequestRecord", "ServeFront", "ServeFrontConfig",
+    "AdmissionConfig", "AdmissionController", "AdmissionError",
+    "BreakerConfig", "BrownoutConfig", "BrownoutController",
+    "CircuitBreaker", "CircuitOpen", "DeadlineInfeasible", "QueueFull",
+    "RetryBudget", "RetryBudgetConfig", "RetryBudgetExhausted",
+    "ServeFrontConfigError",
+    "SoakConfig", "run_soak",
 ]

@@ -37,7 +37,6 @@ import functools
 import json
 import os
 import struct
-import time
 import zlib
 from typing import Any, Callable, Optional
 
@@ -46,6 +45,7 @@ import jax
 
 from ..models.transformer import KVCache, decode_step, prefill
 from ..obs.tracing import span as obs_span
+from ..utils.clock import MONOTONIC, Clock
 
 
 # ---------------------------------------------------------------------------
@@ -115,7 +115,8 @@ class RecoveryConfig:
     halt_at_step: test/ops hook — write a checkpoint after decode step k and
         return the partial generation (simulates a kill at an arbitrary
         step without killing the process).
-    clock: monotonic time source for the watchdog (injectable for tests).
+    clock: monotonic time source for the watchdog (a
+        :class:`~edgellm_tpu.utils.clock.Clock`; injectable for tests).
     """
 
     checkpoint_path: Optional[str] = None
@@ -125,7 +126,7 @@ class RecoveryConfig:
     replan: bool = True
     max_failovers: int = 1
     halt_at_step: Optional[int] = None
-    clock: Callable[[], float] = time.monotonic
+    clock: Clock = MONOTONIC
 
     def __post_init__(self):
         if self.checkpoint_every < 0:
@@ -173,8 +174,7 @@ class Watchdog:
     and inter-chunk hangs, which is where eval loops actually stall.
     """
 
-    def __init__(self, deadline_s: float,
-                 clock: Callable[[], float] = time.monotonic):
+    def __init__(self, deadline_s: float, clock: Clock = MONOTONIC):
         if deadline_s <= 0:
             raise ValueError(f"deadline_s must be > 0, got {deadline_s}")
         self.deadline_s = float(deadline_s)

@@ -1,0 +1,268 @@
+"""Deterministic chaos soak for the serving front.
+
+An open-loop workload (seeded Poisson arrivals on a virtual clock) pushed
+through a :class:`~edgellm_tpu.serve.frontend.ServeFront` while scheduled
+chaos fires mid-run — a whole-stage kill, a link-corruption burst — and a
+verifiable artifact comes out the other side: goodput, SLO attainment,
+reject/shed rates, p99 TTFT, post-kill recovery time, retry-budget
+accounting, and a bit-identity audit of every ``completed`` request against
+a fault-free reference.
+
+Determinism is the whole point — a chaos run that cannot be replayed
+cannot be debugged:
+
+- Time is a :class:`~edgellm_tpu.utils.clock.FakeClock`. Arrivals,
+  deadlines, breaker timeouts, and brownout dwells all live on the virtual
+  timeline; after each served request the clock advances by that request's
+  *measured* service wall time, so the virtual timeline is load-consistent
+  without a single real ``sleep``.
+- The workload is a seeded ``numpy`` RNG: interarrival gaps, prompts, and
+  priorities all replay from ``SoakConfig.seed``.
+- Chaos is scheduled by arrival index, not wall time: the kill fires just
+  before request ``floor(n * kill_at_frac)`` is submitted, the corruption
+  burst spans the ``[burst_start_frac, burst_end_frac)`` arrival window
+  (schedule the burst before the kill — after a stage-loss replan the
+  pre-kill burst runtime no longer matches the topology, so the restore is
+  skipped).
+- Fault injection itself is the seeded in-graph machinery of
+  ``codecs.faults`` — the same virtual run replays the same corrupted hops.
+
+The identity audit holds ``completed`` to its contract: for each completed
+request, the same seed/prompt/shape replays on a *fault-free* runtime of
+the same plan (same cuts, same codecs, same mesh — captured when the plan
+first served), and the tokens must match bit-for-bit. Verified transport
+is only worth building if the service above it cannot quietly serve
+garbage with a green status.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+import jax
+
+from ..utils.clock import FakeClock
+from .decode import generate, generate_split
+from .frontend import Request, ServeFront
+from .overload import COMPLETED, FAILED_OVER, REJECTED, SHED
+
+__all__ = ["SoakConfig", "run_soak"]
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakConfig:
+    """The replayable soak definition. ``arrival_rate`` is requests per
+    virtual second; ``deadline_s`` applies to every request (None =
+    best-effort); ``priority_levels`` spreads requests uniformly over
+    priorities ``0..levels-1``. Chaos: ``kill_stage``/``kill_at_frac``
+    schedule the stage kill, the burst window is actuated by the
+    ``burst_runtime`` argument of :func:`run_soak`. ``verify_identity``
+    re-runs every completed request on a clean reference (the expensive
+    half of the soak — turn it off for pure throughput runs)."""
+
+    n_requests: int = 32
+    arrival_rate: float = 2.0
+    seed: int = 0
+    prompt_len: int = 8
+    max_new_tokens: int = 8
+    deadline_s: Optional[float] = 60.0
+    temperature: float = 0.7
+    priority_levels: int = 2
+    kill_stage: Optional[int] = None
+    kill_at_frac: float = 0.5
+    burst_start_frac: float = 0.15
+    burst_end_frac: float = 0.35
+    verify_identity: bool = True
+
+    def __post_init__(self):
+        if self.n_requests < 1:
+            raise ValueError("n_requests must be >= 1")
+        if self.arrival_rate <= 0:
+            raise ValueError("arrival_rate must be > 0")
+        for f in ("kill_at_frac", "burst_start_frac", "burst_end_frac"):
+            v = getattr(self, f)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"{f} must be in [0, 1], got {v!r}")
+        if self.burst_end_frac < self.burst_start_frac:
+            raise ValueError("burst_end_frac must be >= burst_start_frac")
+        if self.priority_levels < 1:
+            raise ValueError("priority_levels must be >= 1")
+
+
+def _plan_key(plan: Optional[dict]) -> tuple:
+    if plan is None or plan.get("mode") != "split":
+        return ("local",)
+    return ("split", tuple(plan["cuts"]), tuple(plan["hop_codecs"]))
+
+
+def _verify_completed(front: ServeFront, records: list, submitted: dict,
+                      plan_meshes: dict) -> dict:
+    """Replay every completed request on a clean same-plan runtime and
+    compare tokens bit-for-bit. ``submitted`` maps request id to the exact
+    (prompt, temperature) the soak submitted; ``plan_meshes`` maps split
+    plan keys to the (SplitConfig, Mesh) that served them."""
+    from ..parallel.split import SplitConfig, SplitRuntime
+
+    ref_runners: dict = {}
+    checked = matched = 0
+    mismatched_ids = []
+    for r in records:
+        if r.outcome != COMPLETED or r.tokens is None:
+            continue
+        if r.request_id not in submitted:
+            continue
+        prompt, temperature = submitted[r.request_id]
+        key = _plan_key(r.plan)
+        if key not in ref_runners:
+            if key[0] == "local":
+                ref_runners[key] = None
+            else:
+                split, mesh = plan_meshes[key]
+                clean = SplitRuntime(front.model_cfg,
+                                     SplitConfig(cuts=split.cuts,
+                                                 hop_codecs=split.hop_codecs),
+                                     mesh)
+                ref_runners[key] = (clean, clean.place_params(front.params))
+        runner = ref_runners[key]
+        rng = jax.random.key(0)  # the soak submits every request with seed 0
+        if runner is None:
+            ref = generate(front.model_cfg, front.params, prompt,
+                           r.granted_tokens, capacity=r.capacity,
+                           temperature=temperature, rng_key=rng,
+                           compute_dtype=front.compute_dtype)
+        else:
+            clean, placed = runner
+            ref = generate_split(clean, placed, prompt, r.granted_tokens,
+                                 capacity=r.capacity,
+                                 temperature=temperature, rng_key=rng,
+                                 fault_step=r.request_id)
+        checked += 1
+        if np.array_equal(np.asarray(ref), r.tokens):
+            matched += 1
+        else:
+            mismatched_ids.append(r.request_id)
+    return {"checked": checked, "matched": matched,
+            "ok": checked == matched, "mismatched_ids": mismatched_ids}
+
+
+def run_soak(front: ServeFront, soak: SoakConfig, *, clock: FakeClock,
+             burst_runtime: Any = None) -> dict:
+    """Run one deterministic soak; returns the artifact dict.
+
+    ``front`` must be freshly built on ``clock`` (the soak owns the virtual
+    timeline, and the artifact's rates assume the front's records are this
+    soak's records). ``burst_runtime``, when given, is a same-topology split
+    runtime with burst-level corruption: it is swapped in over the burst
+    arrival window (breaker state preserved) and the original runtime is
+    restored afterwards — unless a stage-loss replan happened in between,
+    in which case the replanned runtime stands."""
+    if not isinstance(clock, FakeClock):
+        raise TypeError("run_soak needs the front's FakeClock — the soak "
+                        "owns the virtual timeline")
+    rng = np.random.default_rng(soak.seed)
+    n = soak.n_requests
+    arrive_t = clock.now + np.cumsum(
+        rng.exponential(1.0 / soak.arrival_rate, n))
+    vocab = front.model_cfg.vocab_size
+    prompts = rng.integers(0, vocab, (n, soak.prompt_len), dtype=np.int32)
+    priorities = rng.integers(0, soak.priority_levels, n)
+
+    kill_idx = (int(n * soak.kill_at_frac)
+                if soak.kill_stage is not None else None)
+    burst_on_idx = (int(n * soak.burst_start_frac)
+                    if burst_runtime is not None else None)
+    burst_off_idx = (int(n * soak.burst_end_frac)
+                     if burst_runtime is not None else None)
+    normal_rt = front.split_runtime
+    failovers_at_burst_on = 0
+    kill_at_s: Optional[float] = None
+    burst_window_s: list = []
+
+    submitted: dict = {}       # request id -> (prompt (1, S), temperature)
+    plan_meshes: dict = {}     # split plan key -> (SplitConfig, Mesh)
+    records: list = []
+    start_s = clock.now
+
+    def fire_events(i: int) -> None:
+        nonlocal kill_at_s, failovers_at_burst_on
+        if burst_on_idx is not None and i == burst_on_idx:
+            failovers_at_burst_on = front.failovers
+            burst_window_s.append(clock.now)
+            front.set_split_runtime(burst_runtime, keep_breakers=True)
+        if burst_off_idx is not None and i == burst_off_idx:
+            burst_window_s.append(clock.now)
+            if front.failovers == failovers_at_burst_on:
+                front.set_split_runtime(normal_rt, keep_breakers=True)
+        if kill_idx is not None and i == kill_idx:
+            kill_at_s = clock.now
+            if front.split_runtime is not None:
+                front.split_runtime.mark_stage_lost(soak.kill_stage)
+
+    i = 0
+    while i < n or front.queue_depth:
+        if front.queue_depth == 0 and i < n and clock.now < arrive_t[i]:
+            # host numpy scalar, not a device sync
+            clock.set_time(float(arrive_t[i]))  # graphlint: disable=EG005
+        while i < n and arrive_t[i] <= clock.now:
+            fire_events(i)
+            rid = front.submit(Request(
+                prompt_ids=prompts[i], max_new_tokens=soak.max_new_tokens,
+                priority=int(priorities[i]),  # graphlint: disable=EG005
+                deadline_s=soak.deadline_s,
+                temperature=soak.temperature, rng_seed=0))
+            submitted[rid] = (prompts[i][None, :], soak.temperature)
+            i += 1
+        for rec in front.drain(max_requests=1):
+            records.append(rec)
+            if rec.service_s is not None:
+                clock.advance(rec.service_s)
+            if rec.plan is not None and rec.plan.get("mode") == "split":
+                key = _plan_key(rec.plan)
+                if key not in plan_meshes:
+                    rt = front.split_runtime
+                    plan_meshes[key] = (rt.split, rt.mesh)
+    span_s = max(clock.now - start_s, 1e-9)
+
+    # recovery time: kill -> first request finishing cleanly afterwards
+    recovery_s = None
+    if kill_at_s is not None:
+        done_after = [r.finished_at for r in records
+                      if r.outcome in (COMPLETED, FAILED_OVER)
+                      and r.finished_at is not None
+                      and r.finished_at > kill_at_s]
+        if done_after:
+            recovery_s = min(done_after) - kill_at_s
+
+    report = front.report()
+    outcomes = report["outcomes"]
+    identity = (_verify_completed(front, records, submitted, plan_meshes)
+                if soak.verify_identity else None)
+
+    budget = report["retry_budget"]
+    max_call = max((r.retries_charged for r in records), default=0)
+    budget_bound = (budget["capacity"]
+                    + budget["refill_per_s"] * span_s + max_call)
+    return {
+        "soak": dataclasses.asdict(soak),
+        "virtual_span_s": span_s,
+        "requests": n,
+        "outcomes": outcomes,
+        "goodput_tokens_per_s": report["tokens_out"] / span_s,
+        "slo_attainment": report["slo_attainment"],
+        "reject_rate": outcomes.get(REJECTED, 0) / n,
+        "shed_rate": outcomes.get(SHED, 0) / n,
+        "p99_ttft_s": (report["ttft_s"] or {}).get("p99"),
+        "p99_latency_s": (report["latency_s"] or {}).get("p99"),
+        "kill": (None if kill_at_s is None else
+                 {"stage": soak.kill_stage, "at_s": kill_at_s,
+                  "recovery_s": recovery_s}),
+        "burst": (None if not burst_window_s else
+                  {"start_s": burst_window_s[0],
+                   "end_s": (burst_window_s[1]
+                             if len(burst_window_s) > 1 else None)}),
+        "retry_budget": {**budget, "max_single_call": max_call,
+                         "within_budget": budget["spent"] <= budget_bound},
+        "token_identity": identity,
+        "report": report,
+    }

@@ -1,0 +1,87 @@
+"""One clock protocol for every host-side time consumer.
+
+Before this module, each host-side controller grew its own injectable time
+source: ``Watchdog``/``RecoveryConfig`` took a ``Callable[[], float]``,
+``LinkHealth`` another, the eval harness a ``_clock`` kwarg, and every test
+file re-invented its own fake (an attribute-mutated callable here, an
+``iter(...).__next__`` there). The contract was always the same —
+*monotonic seconds as a zero-arg callable* — so it lives here once:
+
+- :class:`Clock` — the protocol (``() -> float``). ``time.monotonic``
+  satisfies it; so does any test double.
+- :data:`MONOTONIC` — the production default, aliased so call sites read as
+  intent (``clock: Clock = MONOTONIC``) instead of an import of ``time``.
+- :class:`FakeClock` — the shared test double: starts at 0.0 (or
+  ``start``), returns the same instant until ``advance``/``set_time`` move
+  it. Deterministic controllers (watchdog deadlines, LinkHealth dwell,
+  breaker reset timeouts, brownout hysteresis) are all driven by it in
+  tests and by :func:`time.monotonic` in production, with no code diff.
+- :func:`sequence_clock` — a clock that replays an explicit list of
+  instants, one per read, for tests that assert *how many times* the clock
+  is consulted (the watchdog reads twice per passing check).
+
+Nothing here imports anything from the package — every layer may depend on
+it without cycles.
+"""
+from __future__ import annotations
+
+import time
+from typing import Iterable, Protocol, runtime_checkable
+
+__all__ = ["Clock", "MONOTONIC", "FakeClock", "sequence_clock"]
+
+
+@runtime_checkable
+class Clock(Protocol):
+    """Zero-arg callable returning monotonic seconds."""
+
+    def __call__(self) -> float: ...
+
+
+#: the production clock: monotonic, immune to wall-clock steps/NTP slew
+MONOTONIC: Clock = time.monotonic
+
+
+class FakeClock:
+    """A clock that only moves when the test says so.
+
+    Reads are free and repeatable; :meth:`advance` moves time forward by a
+    delta, :meth:`set_time` jumps to an absolute instant (both refuse to go
+    backwards — the protocol promises monotonicity, and a controller that
+    silently tolerated regressing time would hide real bugs).
+    """
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def __call__(self) -> float:
+        return self._now
+
+    @property
+    def now(self) -> float:
+        return self._now
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"cannot advance a monotonic clock by {dt}")
+        self._now += float(dt)
+        return self._now
+
+    def set_time(self, t: float) -> float:
+        if t < self._now:
+            raise ValueError(
+                f"cannot move a monotonic clock backwards "
+                f"({self._now} -> {t}); use a fresh FakeClock")
+        self._now = float(t)
+        return self._now
+
+
+def sequence_clock(instants: Iterable[float]) -> Clock:
+    """A clock that replays ``instants`` in order, one per read.
+
+    For tests that pin the exact read schedule (e.g. the watchdog reads the
+    clock once for the elapsed check and once to re-arm). Running out of
+    instants raises ``StopIteration`` — a test consuming more reads than it
+    scripted is a test bug, surfaced loudly."""
+    it = iter(instants)
+    return lambda: float(next(it))

@@ -27,6 +27,7 @@ from edgellm_tpu.codecs.fec import (FECConfig, HedgeConfig, LinkHealth,
 from edgellm_tpu.codecs.faults import seal_payload
 from edgellm_tpu.models import init_params, tiny_config
 from edgellm_tpu.parallel import SplitConfig, SplitRuntime, make_stage_mesh
+from edgellm_tpu.utils.clock import FakeClock
 
 CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4,
                   vocab_size=128)
@@ -365,14 +366,6 @@ def _obs(hops=4, detected=0, repaired=0, retried=0):
             "retried": [retried]}
 
 
-class FakeClock:
-    def __init__(self):
-        self.t = 0.0
-
-    def __call__(self):
-        return self.t
-
-
 def test_link_health_degrades_on_burn_and_repromotes():
     clk = FakeClock()
     lh = LinkHealth(3, LinkHealthConfig(window=4, error_budget=0.1,
@@ -417,15 +410,15 @@ def test_link_health_dwell_hysteresis_under_fake_clock():
     assert lh.observe(_obs(detected=2)) == 1      # degrade at t=0
     for _ in range(6):                            # clean, but inside dwell
         assert lh.observe(_obs()) == 1
-    clk.t = 9.9
+    clk.set_time(9.9)
     assert lh.observe(_obs()) == 1                # still inside
-    clk.t = 10.0
+    clk.set_time(10.0)
     assert lh.observe(_obs()) == 0                # dwell elapsed -> promote
     # and the switch re-arms the dwell: an immediately-burning window cannot
     # flap back down before t=20
     lh.observe(_obs(detected=4))
     assert lh.observe(_obs(detected=4)) == 0
-    clk.t = 20.0
+    clk.set_time(20.0)
     lh.observe(_obs(detected=4))
     assert lh.observe(_obs(detected=4)) == 1
 

@@ -1,0 +1,305 @@
+"""Overload-robust serving front: admission, breakers, brownout, soak (PR 7).
+
+Correctness anchors, in order of importance:
+
+- a request the front reports ``completed`` is TOKEN-IDENTICAL to calling
+  ``generate``/``generate_split`` directly with the same seed and the same
+  (batch, capacity) plan — the front adds scheduling, never different math;
+- the deterministic chaos soak survives a mid-soak stage kill: at least one
+  request fails over onto the re-planned boundary, a post-kill recovery
+  time is measured, every completed request still matches its fault-free
+  reference, and total ladder retries stay inside the process-wide budget;
+- circuit breakers walk closed -> open -> half-open -> closed on an
+  injected fake clock, with failed probes re-opening the circuit;
+- admission rejects are typed and recorded: a full queue and an infeasible
+  deadline each name their reason without touching a device;
+- the retry budget is process-wide back-pressure: once a forced-bad link
+  drains it, the front refuses the faulted route instead of funding a
+  retry storm (with fallback disabled, the request is rejected);
+- brownout walks one level per dwell in BOTH directions — recovering load
+  cannot flap the service back to full quality without re-earning it.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.codecs.faults import FaultConfig, LinkPolicy
+from edgellm_tpu.models import init_params, tiny_config
+from edgellm_tpu.parallel import SplitConfig, SplitRuntime, make_stage_mesh
+from edgellm_tpu.serve import (AdmissionConfig, AdmissionController,
+                               BreakerConfig, BrownoutConfig,
+                               BrownoutController, CircuitBreaker,
+                               DeadlineInfeasible, QueueFull, Request,
+                               RetryBudgetConfig, ServeFront,
+                               ServeFrontConfig, generate, generate_split)
+from edgellm_tpu.serve.soak import SoakConfig, run_soak
+from edgellm_tpu.utils.clock import FakeClock
+
+CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4,
+                  vocab_size=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(1))
+
+
+def _prompt(seed=3, batch=1, seq=8):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG.vocab_size, (batch, seq)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker: fake-clock state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_full_cycle_on_fake_clock():
+    clk = FakeClock()
+    br = CircuitBreaker("x", BreakerConfig(failure_threshold=3,
+                                           reset_timeout_s=10.0,
+                                           half_open_probes=1), clock=clk)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "closed"  # under threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    clk.advance(9.9)
+    assert br.state == "open"  # timeout not elapsed
+    clk.advance(0.2)
+    assert br.state == "half_open"
+    assert br.allow()       # the probe
+    assert not br.allow()   # probes exhausted until an outcome lands
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+
+
+def test_breaker_failed_probe_reopens():
+    clk = FakeClock()
+    br = CircuitBreaker("x", BreakerConfig(failure_threshold=1,
+                                           reset_timeout_s=5.0), clock=clk)
+    br.record_failure()
+    assert br.state == "open"
+    clk.advance(5.1)
+    assert br.state == "half_open" and br.allow()
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    clk.advance(5.1)
+    assert br.state == "half_open"  # and the cycle can repeat
+
+
+def test_breaker_burn_rate_signal():
+    clk = FakeClock()
+    br = CircuitBreaker("link0", BreakerConfig(failure_threshold=2,
+                                               burn_threshold=1.0), clock=clk)
+    br.observe_burn(0.3)
+    br.observe_burn(2.0)
+    assert br.state == "closed"
+    br.observe_burn(1.5)
+    assert br.state == "open"  # two consecutive over-budget readings
+
+
+# ---------------------------------------------------------------------------
+# admission: typed rejects before any device work
+# ---------------------------------------------------------------------------
+
+
+def test_admission_typed_rejects():
+    ctl = AdmissionController(AdmissionConfig(max_queue_depth=4))
+    ctl.admit(8, 8, 0, None)  # best-effort always admits below depth
+    with pytest.raises(QueueFull):
+        ctl.admit(8, 8, 4, None)
+    with pytest.raises(DeadlineInfeasible):
+        ctl.admit(8, 8, 0, 1e-4)
+    assert ctl.rejected_queue_full == 1 and ctl.rejected_deadline == 1
+
+
+def test_front_records_queue_full_and_deadline_rejects(params):
+    front = ServeFront(
+        CFG, params,
+        config=ServeFrontConfig(admission=AdmissionConfig(max_queue_depth=2)),
+        clock=FakeClock())
+    p = _prompt()
+    for _ in range(2):
+        front.submit(Request(prompt_ids=p, max_new_tokens=4))
+    rid = front.submit(Request(prompt_ids=p, max_new_tokens=4))
+    rec = front.records[-1]
+    assert (rec.request_id == rid and rec.outcome == "rejected"
+            and rec.reason == "queue_full")
+
+    front2 = ServeFront(CFG, params, clock=FakeClock())
+    front2.submit(Request(prompt_ids=p, max_new_tokens=8, deadline_s=1e-4))
+    rec = front2.records[-1]
+    assert rec.outcome == "rejected" and rec.reason == "deadline_infeasible"
+    assert rec.tokens is None  # never touched a device
+
+
+# ---------------------------------------------------------------------------
+# brownout: degrade ladder + dwell hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_brownout_degrades_and_repromotes_with_dwell():
+    clk = FakeClock()
+    bo = BrownoutController(BrownoutConfig(degrade_load=0.8,
+                                           promote_load=0.2,
+                                           min_dwell_s=5.0), clock=clk)
+    assert bo.observe(0.9) == 1
+    assert bo.observe(0.9) == 1  # dwell holds the level
+    clk.advance(5.0)
+    assert bo.observe(0.9) == 2
+    assert bo.tier_bias == 1 and not bo.hedging_enabled
+    clk.advance(5.0)
+    assert bo.observe(0.9) == 3
+    assert bo.token_cap(8) == 4  # token-cap shrink kicks in
+    clk.advance(5.0)
+    assert bo.observe(0.9) == 4
+    assert bo.should_shed(0) and not bo.should_shed(1)
+    # recovery must re-earn each level through the same dwell
+    assert bo.observe(0.1) == 4
+    clk.advance(5.0)
+    assert bo.observe(0.1) == 3
+    assert bo.observe(0.1) == 3
+    clk.advance(5.0)
+    assert bo.observe(0.1) == 2
+    assert bo.mode == "hedging_off" and bo.token_cap(8) == 8
+
+
+def test_front_sheds_lowest_priority_under_brownout(params):
+    clk = FakeClock()
+    front = ServeFront(
+        CFG, params,
+        config=ServeFrontConfig(
+            brownout=BrownoutConfig(min_dwell_s=1000.0)),
+        clock=clk)
+    for _ in range(4):
+        clk.advance(1000.0)
+        front.brownout.observe(1.0)
+    assert front.brownout.level == 4
+    p = _prompt()
+    front.submit(Request(prompt_ids=p, max_new_tokens=4, priority=0))
+    rec = front.records[-1]
+    assert rec.outcome == "shed" and rec.reason == "brownout_shed"
+    depth_before = front.queue_depth
+    front.submit(Request(prompt_ids=p, max_new_tokens=4, priority=1))
+    assert front.queue_depth == depth_before + 1  # above the floor: queued
+
+
+# ---------------------------------------------------------------------------
+# token identity: the front never changes the math
+# ---------------------------------------------------------------------------
+
+
+def test_front_local_tokens_identical_to_direct_generate(params):
+    front = ServeFront(CFG, params, clock=FakeClock())
+    p = _prompt(seed=11)
+    front.submit(Request(prompt_ids=p, max_new_tokens=6, temperature=0.7,
+                         rng_seed=5))
+    rec = front.drain()[0]
+    assert rec.outcome == "completed"
+    ref = generate(CFG, params, jnp.asarray(p), 6, capacity=rec.capacity,
+                   temperature=0.7, rng_key=jax.random.key(5))
+    assert np.array_equal(rec.tokens, np.asarray(ref))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+def test_front_split_tokens_identical_to_direct_generate_split(params):
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(2,), hop_codecs=("fp32",)),
+                      make_stage_mesh(2))
+    front = ServeFront(CFG, params, split_runtime=rt, clock=FakeClock())
+    p = _prompt(seed=12)
+    front.submit(Request(prompt_ids=p, max_new_tokens=6, temperature=0.7,
+                         rng_seed=9))
+    rec = front.drain()[0]
+    assert rec.outcome == "completed" and rec.plan["mode"] == "split"
+    ref = generate_split(rt, rt.place_params(params), jnp.asarray(p), 6,
+                         capacity=rec.capacity, temperature=0.7,
+                         rng_key=jax.random.key(9))
+    assert np.array_equal(rec.tokens, np.asarray(ref))
+
+
+def test_steady_state_is_jit_miss_free(params):
+    front = ServeFront(CFG, params, clock=FakeClock())
+    for seed in (0, 1):
+        front.submit(Request(prompt_ids=_prompt(seed=seed), max_new_tokens=4))
+    recs = front.drain()
+    assert [r.outcome for r in recs] == ["completed", "completed"]
+    assert recs[1].jit_misses == 0  # second same-shape request: compiled plan
+
+
+# ---------------------------------------------------------------------------
+# retry budget: process-wide back-pressure against retry storms
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2, reason="needs 2 devices")
+def test_retry_budget_exhaustion_refuses_the_bad_link(params):
+    clk = FakeClock()
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(2,), hop_codecs=("fp32",)),
+                      make_stage_mesh(2),
+                      faults=FaultConfig(drop_rate=0.9, seed=0),
+                      policy=LinkPolicy(max_retries=4))
+    front = ServeFront(
+        CFG, params, split_runtime=rt,
+        config=ServeFrontConfig(
+            retry_budget=RetryBudgetConfig(capacity=1, refill_per_s=0.0),
+            local_fallback=False),
+        clock=clk)
+    p = _prompt(seed=7)
+    front.submit(Request(prompt_ids=p, max_new_tokens=4))
+    first = front.drain()[0]
+    # the forced-bad link burns retries on every hop; the post-hoc charge
+    # may overdraw the bucket by at most this one call
+    assert first.retries_charged >= 1
+    assert front.budget.exhausted()
+    front.submit(Request(prompt_ids=p, max_new_tokens=4))
+    rec = front.drain()[0]
+    assert rec.outcome == "rejected"
+    assert rec.reason == "retry_budget_exhausted"
+    assert front.budget.denied >= 1
+    # spending stopped: the refused request charged nothing
+    assert front.budget.spent == first.retries_charged
+
+
+# ---------------------------------------------------------------------------
+# the deterministic chaos soak
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 3, reason="needs 3 devices")
+def test_soak_survives_stage_kill_and_corruption_burst(params):
+    clk = FakeClock()
+    split = SplitConfig(cuts=(1, 3), hop_codecs=("fp32", "fp32"))
+    mesh = make_stage_mesh(3)
+    policy = LinkPolicy(max_retries=4)
+    rt = SplitRuntime(CFG, split, mesh,
+                      faults=FaultConfig(drop_rate=0.02, seed=0),
+                      policy=policy)
+    burst = SplitRuntime(CFG, split, mesh,
+                         faults=FaultConfig(drop_rate=0.2, seed=0),
+                         policy=policy)
+    front = ServeFront(CFG, params, split_runtime=rt, clock=clk)
+    soak = SoakConfig(n_requests=10, arrival_rate=0.5, prompt_len=8,
+                      max_new_tokens=6, deadline_s=120.0, kill_stage=1)
+    art = run_soak(front, soak, clock=clk, burst_runtime=burst)
+
+    assert art["requests"] == 10
+    assert art["outcomes"].get("failed_over", 0) >= 1  # the kill was felt
+    assert art["kill"]["recovery_s"] is not None       # and recovered from
+    # the contract the soak exists to enforce: completed == bit-identical
+    # to the fault-free reference, and retries stayed inside the budget
+    identity = art["token_identity"]
+    assert identity["checked"] > 0 and identity["ok"]
+    assert art["retry_budget"]["within_budget"]
+    assert art["goodput_tokens_per_s"] > 0
+    # the replanned boundary persists: the front now serves 2 stages
+    assert front.split_runtime.split.n_stages == 2
+
+
+def test_soak_requires_the_fronts_fake_clock(params):
+    front = ServeFront(CFG, params, clock=FakeClock())
+    with pytest.raises(TypeError):
+        run_soak(front, SoakConfig(n_requests=1), clock=None)

@@ -34,6 +34,7 @@ from edgellm_tpu.serve import (CheckpointError, DecodeCheckpoint,
                                DecodeTimeout, LocalRuntime, RecoveryConfig,
                                StageFailure, StageLostError, Watchdog,
                                generate, generate_split, resume_split)
+from edgellm_tpu.utils.clock import sequence_clock
 
 SPLIT_CFG = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4,
                         vocab_size=128)
@@ -294,7 +295,7 @@ def test_local_generate_halt_and_resume(tmp_path):
 def test_watchdog_fires_deterministically():
     # each passing check reads the clock twice: once for elapsed, once to
     # re-arm
-    clock = iter([0.0, 1.0, 2.0, 3.0, 3.5, 100.0]).__next__
+    clock = sequence_clock([0.0, 1.0, 2.0, 3.0, 3.5, 100.0])
     wd = Watchdog(5.0, clock=clock)
     wd.arm()           # armed at t=0
     wd.check()         # elapsed 1.0: within deadline, re-arms at t=2.0
@@ -304,7 +305,7 @@ def test_watchdog_fires_deterministically():
 
 
 def test_watchdog_writes_best_effort_checkpoint():
-    clock = iter([0.0, 100.0]).__next__
+    clock = sequence_clock([0.0, 100.0])
     wd = Watchdog(1.0, clock=clock)
     wd.arm()
     wrote = []
@@ -312,7 +313,7 @@ def test_watchdog_writes_best_effort_checkpoint():
         wd.check(lambda: wrote.append(1))
     assert wrote == [1]
     # a failing checkpoint sink must not mask the timeout
-    clock2 = iter([0.0, 100.0]).__next__
+    clock2 = sequence_clock([0.0, 100.0])
     wd2 = Watchdog(1.0, clock=clock2)
     wd2.arm()
     with pytest.raises(DecodeTimeout):
@@ -321,14 +322,13 @@ def test_watchdog_writes_best_effort_checkpoint():
 
 def test_decode_watchdog_fires_with_fake_clock(setup, tmp_path):
     s = setup
-    tick = iter(range(0, 100000, 100))
     ckpt = str(tmp_path / "wd.ckpt")
     with pytest.raises(DecodeTimeout):
         generate_split(s["rt"], s["placed"], s["ids"], MAX_NEW,
                        temperature=TEMP, rng_key=s["key"],
                        recovery=RecoveryConfig(
                            checkpoint_path=ckpt, deadline_s=1.0,
-                           clock=lambda: float(next(tick))))
+                           clock=sequence_clock(range(0, 100000, 100))))
     # the expiring check wrote a best-effort checkpoint we can resume from
     full = resume_split(s["rt"], s["placed"], ckpt)
     assert np.array_equal(np.asarray(full), s["clean"])
@@ -388,12 +388,11 @@ def test_eval_watchdog_fires_with_fake_clock(eval_setup):
     from edgellm_tpu.eval.split_eval import run_split_eval
 
     e = eval_setup
-    tick = iter(range(0, 1000000, 100))
     with pytest.raises(DecodeTimeout):
         run_split_eval(SPLIT_CFG, e["params"], e["toks"], cuts=[1, 3],
                        hop_codecs=["fp32", "fp32"], max_length=64, stride=32,
                        time_hops=False, deadline_s=1.0,
-                       _clock=lambda: float(next(tick)))
+                       _clock=sequence_clock(range(0, 1000000, 100)))
 
 
 def test_eval_rejects_ring_stage_failure(eval_setup):
