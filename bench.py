@@ -98,6 +98,25 @@ BENCH_SOAK_DEADLINE_S (virtual-seconds deadline per request, default 60),
 BENCH_SOAK_CORRUPT (burst-window per-attempt drop rate, default 0.2),
 BENCH_SOAK_SEED, plus the shared BENCH_MODEL / BENCH_DTYPE.
 
+BENCH_SERVE=1 switches to the continuous-batching workload (see
+``serve_main``): the SAME seeded Poisson open-loop arrival trace is served
+twice on a virtual clock — once by the paged continuous batcher (streams
+admitted/evicted mid-flight into one compiled ragged step) and once by
+classic static batching (wait for a full batch, pad every row to the
+worst case, run ``generate``). The artifact reports sustained tokens/s,
+p50/p99 per-token latency, p50/p99 TTFT, and mean cache-slot occupancy
+(live tokens per reserved token — static reserves batch x worst-case up
+front, the paged server reserves only allocated pages) for both, plus the
+occupancy delta (the paged pool's reason to exist). Knobs:
+BENCH_SERVE_REQUESTS (default 24), BENCH_SERVE_RATE (virtual arrivals/s,
+default 2.0), BENCH_SERVE_PROMPT (max prompt tokens, default 16 — lengths
+draw uniformly from [PROMPT/2, PROMPT]), BENCH_SERVE_TOKENS (max new
+tokens, default 16, same ragged draw), BENCH_SERVE_SLOTS (concurrent
+streams / static batch size, default 8), BENCH_SERVE_PAGE_SIZE (default
+8), BENCH_SERVE_PAGES (pool pages incl. the trash page; default sizes the
+pool to the static baseline's reservation), BENCH_SERVE_SEED, plus the
+shared BENCH_MODEL / BENCH_DTYPE.
+
 Every artifact (headline sidecar) carries a ``meta`` provenance block —
 schema_version, git commit, jax/jaxlib versions, backend, UTC timestamp —
 attached centrally in ``_emit``; readers must tolerate its absence in
@@ -836,6 +855,218 @@ def obs_main():
         obs.disable()
 
 
+def serve_main():
+    """BENCH_SERVE=1: continuous batching vs static batching, same load.
+
+    One seeded Poisson arrival trace, two servers, one virtual clock that
+    advances by each step's measured device wall time:
+
+    - **continuous**: every arrival at or before virtual-now is submitted to
+      the :class:`ContinuousBatcher`; each ``step()`` admits what fits,
+      advances every running slot one ragged position, and frees slots the
+      moment a stream finishes.
+    - **static**: requests queue until ``BENCH_SERVE_SLOTS`` of them exist
+      (or arrivals are exhausted), every prompt pads to the batch max,
+      every row decodes to the batch-max new tokens at the batch-max
+      capacity, and the whole batch occupies its worst-case reservation
+      until the LAST row finishes.
+
+    Cache-slot occupancy is live tokens / RESERVED tokens for both servers
+    — the same metric, different reservation policies. Static reserves
+    batch x worst-case capacity up front for the batch's whole run, so its
+    reservation carries padding and rows that finished early. The paged
+    server reserves only allocated pages (``alloc_util_mean`` from the
+    batcher's own per-step samples), so its waste is bounded by one
+    partial page per stream. The pool-level ratio (live / whole pool) is
+    kept in the detail sidecar as ``pool_occupancy_mean``."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+    from edgellm_tpu.serve.decode import generate
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "24"))
+    rate = float(os.environ.get("BENCH_SERVE_RATE", "2.0"))
+    prompt_max = int(os.environ.get("BENCH_SERVE_PROMPT", "16"))
+    tokens_max = int(os.environ.get("BENCH_SERVE_TOKENS", "16"))
+    slots = int(os.environ.get("BENCH_SERVE_SLOTS", "8"))
+    page_size = int(os.environ.get("BENCH_SERVE_PAGE_SIZE", "8"))
+    seed = int(os.environ.get("BENCH_SERVE_SEED", "0"))
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            size=int(rng.integers(max(prompt_max // 2, 1),
+                                                  prompt_max + 1))
+                            ).astype(np.int32)
+               for _ in range(n_requests)]
+    new_tokens = [int(rng.integers(max(tokens_max // 2, 1), tokens_max + 1))
+                  for _ in range(n_requests)]
+
+    span = prompt_max + tokens_max            # worst-case positions per slot
+    pages_per_slot = -(-span // page_size)
+    num_pages = int(os.environ.get(
+        "BENCH_SERVE_PAGES", str(1 + slots * pages_per_slot)))
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+
+    # ---- continuous batching ------------------------------------------
+    bat = ContinuousBatcher(cfg, params, BatchingConfig(
+        page_size=page_size, num_pages=num_pages, max_slots=slots,
+        pages_per_slot=pages_per_slot, compute_dtype=dtype))
+    # warm every executable on a throwaway geometry twin so compile time
+    # never lands on the virtual timeline (shapes, not values, key the jit)
+    warm = ContinuousBatcher(cfg, params, bat.bcfg)
+    for s, m in {(len(p), 1) for p in prompts}:  # one prefill per length
+        warm.submit(np.ones((s,), np.int32), m)
+    warm.submit(np.ones((prompts[0].size,), np.int32), 2)
+    warm.run()
+
+    sid_of = {}
+    t_submit, t_first, t_done = {}, {}, {}
+    token_stamps = {i: [] for i in range(n_requests)}
+    now, nxt = 0.0, 0
+    while len(t_done) < n_requests:
+        while nxt < n_requests and arrivals[nxt] <= now:
+            sid = bat.submit(prompts[nxt], new_tokens[nxt],
+                             rng_seed=seed + nxt)
+            sid_of[sid] = nxt
+            t_submit[nxt] = arrivals[nxt]
+            nxt += 1
+        counts = {sid: len(bat._streams[sid].tokens) for sid in sid_of}
+        t0 = time.monotonic()
+        advanced = bat.step()
+        dt = time.monotonic() - t0
+        if advanced == 0:
+            if nxt >= n_requests:
+                raise RuntimeError("batcher wedged with no future arrivals")
+            now = max(now, arrivals[nxt])  # idle: jump to the next arrival
+            continue
+        now += dt
+        for sid, i in sid_of.items():
+            got = len(bat._streams[sid].tokens)
+            for _ in range(got - counts.get(sid, 0)):
+                token_stamps[i].append(now)
+            if got and i not in t_first:
+                t_first[i] = now
+            if bat._streams[sid].status == "finished" and i not in t_done:
+                t_done[i] = now
+    cont_rep = bat.report()
+    cont = _open_loop_summary(arrivals, t_submit, t_first, t_done,
+                              token_stamps, new_tokens)
+    cont["occupancy_mean"] = cont_rep["alloc_util_mean"]
+    cont["pool_occupancy_mean"] = cont_rep["occupancy_mean"]
+    cont["jit_misses"] = cont_rep["jit_misses"]
+    cont["evicted"] = cont_rep["evicted"]
+
+    # ---- static batching: same trace, padded fixed batches ------------
+    batches = [list(range(i, min(i + slots, n_requests)))
+               for i in range(0, n_requests, slots)]
+    for group in batches:  # pre-warm each (b, s_max, cap, steps) executable
+        s_max = max(prompts[i].size for i in group)
+        m_max = max(new_tokens[i] for i in group)
+        cap = -(-(s_max + m_max) // 16) * 16
+        generate(cfg, params, np.ones((len(group), s_max), np.int32), m_max,
+                 capacity=cap, compute_dtype=dtype,
+                 rng_key=jax.random.key(0))
+    now = 0.0
+    t_submit2, t_first2, t_done2 = {}, {}, {}
+    token_stamps2 = {i: [] for i in range(n_requests)}
+    occ2 = []
+    for group in batches:
+        now = max(now, arrivals[group[-1]])   # batch forms at last arrival
+        for i in group:
+            t_submit2[i] = arrivals[i]
+        s_max = max(prompts[i].size for i in group)
+        m_max = max(new_tokens[i] for i in group)
+        cap = -(-(s_max + m_max) // 16) * 16
+        padded = np.zeros((len(group), s_max), np.int32)
+        for r, i in enumerate(group):
+            padded[r, :prompts[i].size] = prompts[i]
+        t0 = time.monotonic()
+        generate(cfg, params, padded, m_max, capacity=cap,
+                 compute_dtype=dtype, rng_key=jax.random.key(seed))
+        dt = time.monotonic() - t0
+        # attribute wall time uniformly over the m_max lockstep positions;
+        # each request's tokens arrive at its own first new_tokens[i] of them
+        for t in range(1, m_max + 1):
+            stamp = now + dt * t / m_max
+            live = sum(min(prompts[i].size + t, prompts[i].size
+                           + new_tokens[i]) for i in group)
+            occ2.append(live / (len(group) * cap))
+            for i in group:
+                if t <= new_tokens[i]:
+                    token_stamps2[i].append(stamp)
+                    t_first2.setdefault(i, stamp)
+        now += dt
+        for i in group:   # padded rows hold their reservation to batch end
+            t_done2[i] = now
+    stat = _open_loop_summary(arrivals, t_submit2, t_first2, t_done2,
+                              token_stamps2, new_tokens)
+    stat["occupancy_mean"] = float(np.mean(occ2)) if occ2 else 0.0
+
+    detail = {
+        "requests": n_requests, "rate": rate, "seed": seed,
+        "prompt_max": prompt_max, "tokens_max": tokens_max,
+        "slots": slots, "page_size": page_size, "num_pages": num_pages,
+        "pages_per_slot": pages_per_slot,
+        "continuous": cont, "static": stat,
+        "batcher_report": cont_rep,
+    }
+    line = {
+        "metric": (f"{model_name} continuous batching ({n_requests} reqs at "
+                   f"{rate}/s virtual, {slots} slots, page {page_size})"),
+        "value": round(cont["tokens_per_s"], 2),
+        "unit": "sustained tokens/s (virtual)",
+        "vs_baseline": None,  # the reference has no serving layer at all
+        "static_tokens_per_s": round(stat["tokens_per_s"], 2),
+        "p50_token_latency_s": cont["p50_token_latency_s"],
+        "p99_token_latency_s": cont["p99_token_latency_s"],
+        "p50_ttft_s": cont["p50_ttft_s"],
+        "p99_ttft_s": cont["p99_ttft_s"],
+        "occupancy_mean": round(cont["occupancy_mean"], 4),
+        "static_occupancy_mean": round(stat["occupancy_mean"], 4),
+        "occupancy_gain": round(cont["occupancy_mean"]
+                                - stat["occupancy_mean"], 4),
+        "jit_misses": cont["jit_misses"],
+    }
+    _emit(line, detail)
+
+
+def _open_loop_summary(arrivals, t_submit, t_first, t_done, token_stamps,
+                       new_tokens) -> dict:
+    """Shared latency/throughput rollup for one serve run on the virtual
+    clock: sustained tok/s over the busy span, TTFT and inter-token
+    percentiles."""
+    emitted = sum(len(v) for v in token_stamps.values())
+    span = (max(t_done.values()) - float(arrivals[0])) if t_done else 0.0
+    ttfts = [t_first[i] - t_submit[i] for i in t_first]
+    gaps = []
+    for i, stamps in token_stamps.items():
+        if not stamps:
+            continue
+        prev = t_submit[i]
+        for s in stamps:
+            gaps.append(s - prev)
+            prev = s
+
+    def pct(xs, q):
+        return float(np.percentile(np.asarray(xs, np.float64), q)) if xs \
+            else None
+
+    return {
+        "tokens_out": emitted,
+        "span_s": span,
+        "tokens_per_s": (emitted / span) if span > 0 else 0.0,
+        "p50_ttft_s": pct(ttfts, 50), "p99_ttft_s": pct(ttfts, 99),
+        "p50_token_latency_s": pct(gaps, 50),
+        "p99_token_latency_s": pct(gaps, 99),
+    }
+
+
 def soak_main():
     """BENCH_SOAK=1: deterministic chaos soak over the serving front.
 
@@ -1018,6 +1249,8 @@ def main():
         return _run_section("fec", fec_main)
     if os.environ.get("BENCH_SOAK") == "1":
         return _run_section("soak", soak_main)
+    if os.environ.get("BENCH_SERVE") == "1":
+        return _run_section("serve", serve_main)
     return _run_section("sweep", sweep_main)
 
 

@@ -161,6 +161,54 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     (findings.extend(ident) if ident
      else checked.append("frontend.decode-step-identity"))
 
+    # ---- paged KV: the ragged continuous-batching step (collective-free;
+    # ---- the pool buffers must stay donated in the lowered executable) --
+    from ..models import paged_kv
+    from ..serve import batching
+
+    MS, PPS, PGS, NPG = 2, 2, 8, 5  # slots, pages/slot, page size, pages
+    ppool = paged_kv.init_pool(cfg, NPG, PGS)
+    ptab = jnp.zeros((MS, PPS), jnp.int32)
+    plens = jnp.zeros((MS,), jnp.int32)
+    ptoks = jnp.zeros((MS,), jnp.int32)
+    pkeys = jnp.stack([jax.random.key(0)] * MS)
+    psteps = jnp.zeros((MS,), jnp.int32)
+    ptemps = jnp.zeros((MS,), jnp.float32)
+    run_one("paged.decode_step",
+            lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                cfg, p, pk, pv, pt, ln, t),
+            (params, ppool.k, ppool.v, ptab, plens, ptoks),
+            ctx={"donate_min": 2},
+            lowerable=batching._batched_step_jit,
+            lower_args=(cfg, params, ppool.k, ppool.v, ptab, plens, ptoks,
+                        pkeys, psteps, ptemps, None))
+
+    # ---- continuous batching: a single-request paged decode must emit
+    # ---- token-for-token what direct generate() emits. This is the one
+    # ---- driver that EXECUTES (tiny model, a handful of steps) — token
+    # ---- identity is a value property no jaxpr hash can witness ---------
+    try:
+        bat = batching.ContinuousBatcher(
+            cfg, params, batching.BatchingConfig(
+                page_size=PGS, num_pages=NPG, max_slots=MS,
+                pages_per_slot=PPS))
+        bprompt = np.arange(1, 1 + SEQ, dtype=np.int32)
+        sid = bat.submit(bprompt, 6, temperature=0.0, rng_seed=0)
+        got = bat.run()[sid]
+        ref = np.asarray(serve_decode.generate(
+            cfg, params, bprompt[None], 6, capacity=CAPACITY,
+            rng_key=jax.random.key(0)))[0]
+        if not np.array_equal(got, ref):
+            findings.append(Finding(
+                layer="graph", rule="GC-identity",
+                where="batching.decode-step-identity", line=0,
+                message=f"single-request paged decode diverged from direct "
+                        f"generate: {got.tolist()} != {ref.tolist()}"))
+        else:
+            checked.append("batching.decode-step-identity")
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("batching.decode-step-identity", e))
+
     # ---- split pipeline: boundary hops over a real 2-stage mesh ---------
     if len(jax.devices()) < 2:
         skipped.append("split/fault contracts: needs >= 2 devices "
@@ -201,6 +249,24 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
             (placed, k_cache, v_cache, length, tok), step_ctx,
             lowerable=step_fn,
             lower_args=(placed, k_cache, v_cache, length, tok))
+
+    # ---- paged split: the ragged twin of split.decode_step — every cut
+    # ---- still quantizes a (max_slots, 1, D) boundary activation, the
+    # ---- per-stage page pools stay donated ------------------------------
+    spool = rt.init_paged_pool(NPG, PGS)
+    paged_step_shape = (MS, 1, cfg.hidden_size)
+    leaves_p, dtypes_p, _ = _payload_info(rt.codecs[0], paged_step_shape)
+    pstep_fn = rt._paged_decode_fns(NPG, PGS)
+    paged_ctx = {
+        "hop_eqns": n_hops * leaves_p,
+        "wire_dtypes": frozenset(dtypes_p),
+        "wire_bytes": sum(rt.decode_hop_bytes(MS)),
+        "donate_min": 2,  # the per-stage page pools update in place
+    }
+    run_one("split.decode_step_paged", pstep_fn,
+            (placed, spool["k"], spool["v"], ptab, plens, ptoks), paged_ctx,
+            lowerable=pstep_fn,
+            lower_args=(placed, spool["k"], spool["v"], ptab, plens, ptoks))
 
     # ---- faulty link: sealed payloads, statically-unrolled retries ------
     attempts = 2  # 1 try + 1 retry, statically unrolled in the graph

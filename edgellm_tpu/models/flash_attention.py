@@ -99,6 +99,13 @@ VALIDATED_HD = (64, 128)
 #: groups shrink hps until the K/V blocks fit, rather than compiling a
 #: never-validated VMEM footprint on the default path
 MAX_KV_BYTES = 2 * 1024 * 1024
+#: paged-decode gate: total K+V bytes ONE slot's span can reference
+#: (2 * span * KV * hd * itemsize). The paged kernel streams one page per
+#: grid step, so its resident footprint is tiny, but the whole span still
+#: rides through HBM every step — past this budget the step is so deep into
+#: the bandwidth roofline that kernel dispatch cannot win and the gate
+#: refuses rather than extrapolate (same philosophy as MAX_KV_BYTES)
+MAX_PAGED_KV_BYTES = 4 * 1024 * 1024
 
 
 def _use_interpret() -> bool:
@@ -466,28 +473,65 @@ def causal_attention_stats(q, k, v, *, interpret: bool | None = None,
 
 
 def decode_plan(capacity: int, h: int, kv: int, hd: int,
-                itemsize: int = 2):
+                itemsize: int = 2, pages: tuple[int, int] | None = None):
     """Kernel plan for the q_len=1 decode shape — mirrors :func:`kernel_plan`
-    so the probe-cache substitution policy carries over unchanged once a
-    decode kernel is validated on silicon. Today it always returns ``None``:
-    one query row leaves the MXU idle and the step is HBM-bound on the K/V
-    cache read, a regime where XLA's fused dot-product path is already at the
-    bandwidth roofline — there is no measured win to encode, and an
-    unvalidated kernel must not dispatch by default (the same rule
-    ``VALIDATED_HD`` enforces for the prefill kernels). Callers treat the
-    return exactly like :func:`kernel_plan`'s, so a future validated plan
-    slots in without touching the dispatch site."""
-    if os.environ.get("EDGELLM_ATTN") == "xla":
+    so the probe-cache substitution policy carries over unchanged.
+
+    CONTIGUOUS caches (``pages=None``) always return ``None``: one query row
+    leaves the MXU idle and the step is HBM-bound on the K/V cache read, a
+    regime where XLA's fused dot-product path is already at the bandwidth
+    roofline — there is no measured win to encode, and an unvalidated kernel
+    must not dispatch by default (the same rule ``VALIDATED_HD`` enforces for
+    the prefill kernels).
+
+    PAGED caches (``pages=(pages_per_slot, page_size)``) are different: XLA
+    sees a gather-then-attend, materializing every slot's full span in HBM
+    each step, while the Pallas kernel scalar-prefetches the page table and
+    streams each slot's pages directly (Ragged Paged Attention, PAPERS.md) —
+    a genuinely new data path, not a re-tiling of one XLA already has. It
+    still dispatches only when EARNED, per the probe-cache rule: by default
+    the plan requires TPU backend AND a recorded
+    ``measured_win("paged_decode_attention")`` from ``tools/probe_kernels``;
+    ``EDGELLM_ATTN=pallas`` forces it on any backend (interpret mode off-TPU,
+    which is how tier-1 exercises the kernel); ``EDGELLM_ATTN=xla`` forces
+    the gather fallback. The ``itemsize`` scaling tracks the real
+    bytes-per-step the way the prefill gates do."""
+    flag = os.environ.get("EDGELLM_ATTN")
+    if flag == "xla":
         return None
     if hd not in VALIDATED_HD or h % kv:
         return None
-    return None  # no decode kernel validated yet: XLA fallback for all shapes
+    if pages is None:
+        # no contiguous decode kernel validated: XLA fallback for all shapes
+        return None
+    pps, ps = pages
+    if pps * ps != capacity:
+        return None
+    # page rows land in the sublane dim of the (ps, KV*hd) page block; keep
+    # them register-aligned, and keep the span inside the validated window
+    if ps % 8 or capacity > MAX_BLOCKED_S:
+        return None
+    if 2 * capacity * kv * hd * itemsize > MAX_PAGED_KV_BYTES:
+        return None
+    if flag == "pallas":
+        return ("paged", (pps, ps))
+    if jax.default_backend() != "tpu":
+        return None
+    from ..codecs import probe_cache
+
+    if probe_cache.measured_win("paged_decode_attention") is True:
+        return ("paged", (pps, ps))
+    return None
 
 
 def decode_attention(q, k_cache, v_cache, length):
     """Single-position attention against a cache: q (B, 1, H, hd) vs
     k/v_cache (B, capacity, KV, hd) of which the first ``length`` positions
-    are valid (``length`` is traced — one executable per capacity).
+    are valid (``length`` is traced — one executable per capacity). ``length``
+    may be a scalar (one fill level for the whole batch — the contiguous
+    decode path) or a (B,) vector (per-row fill levels — the ragged gather
+    fallback of :func:`paged_decode_attention`); the scalar graph is
+    unchanged by the vector extension.
     Returns (B, 1, H, hd) in q's dtype; softmax in fp32.
 
     GQA broadcasting happens here, not in the cache: the per-group einsum
@@ -514,10 +558,160 @@ def decode_attention(q, k_cache, v_cache, length):
     scores = jnp.einsum("bgrd,bcgd->bgrc", qg, k_cache,
                         preferred_element_type=jnp.float32)
     scores = scores * (1.0 / np.sqrt(hd))
-    valid = jnp.arange(k_cache.shape[1]) < length  # (capacity,)
-    scores = jnp.where(valid[None, None, None, :], scores,
-                       jnp.finfo(jnp.float32).min)
+    if jnp.ndim(length):
+        # ragged: row i masks at its own lengths[i]
+        valid = jnp.arange(k_cache.shape[1])[None, :] < length[:, None]
+        scores = jnp.where(valid[:, None, None, :], scores,
+                           jnp.finfo(jnp.float32).min)
+    else:
+        valid = jnp.arange(k_cache.shape[1]) < length  # (capacity,)
+        scores = jnp.where(valid[None, None, None, :], scores,
+                           jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bgrc,bcgd->bgrd", probs.astype(q.dtype), v_cache,
                      preferred_element_type=jnp.float32).astype(q.dtype)
     return out.reshape(b, 1, h, hd)
+
+
+# ---------------------------------------------------------------------------
+# Paged ragged decode attention: q_len=1 per slot against that slot's page
+# list. Pallas kernel on TPU (plan-gated), XLA gather fallback everywhere.
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, hd, ps, pps):
+    """Grid (B, pages_per_slot): one slot x one of its pages per step.
+
+    The page table and lengths arrive as SCALAR-PREFETCH operands, so the
+    k/v BlockSpec index maps read ``pt[i*pps + j]`` and Mosaic's pipeline
+    DMAs exactly that page — the Ragged Paged Attention trick: no manual
+    copies, no gather materializing the span in HBM. The TPU grid iterates
+    the last dim fastest, so the fp32 m/l/acc VMEM scratch carries the
+    online-softmax state of slot ``i`` across its ``pps`` page steps: reset
+    at j=0, accumulate on pages that intersect the slot's length (whole-page
+    skip via ``pl.when`` — unallocated table entries point at the trash page
+    and are never read), emit acc/l at j=pps-1.
+
+    Unlike the prefill kernels (exact per-row softmax), this IS the
+    online-softmax recurrence, so the output matches the XLA fallback to
+    dtype tolerance, not bitwise — which is why the serve layer's
+    bit-identity story runs on the fallback unless a probe win flips the
+    plan (see decode_plan)."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    kv = k_ref.shape[2] // hd
+    h = q_ref.shape[1] // hd
+    rep = h // kv
+    length = lens_ref[i]
+
+    @pl.when(j == 0)
+    def _reset():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when(j * ps < length)
+    def _compute():
+        pos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        for g in range(kv):
+            k = k_ref[0, :, g * hd:(g + 1) * hd]  # (ps, hd)
+            v = v_ref[0, :, g * hd:(g + 1) * hd]
+            for r in range(rep):
+                hidx = g * rep + r
+                qh = q_ref[0, hidx * hd:(hidx + 1) * hd].reshape(1, hd)
+                s = jax.lax.dot_general(
+                    qh, k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * (1.0 / np.sqrt(hd))
+                s = jnp.where(pos < length, s, -jnp.inf)
+                m_old = m_scr[hidx, 0]
+                m_new = jnp.maximum(m_old, jnp.max(s))
+                p = jnp.exp(s - m_new)  # (1, ps); masked cols exp(-inf) = 0
+                corr = jnp.exp(m_old - m_new)
+                m_scr[hidx, 0] = m_new
+                l_scr[hidx, 0] = l_scr[hidx, 0] * corr + jnp.sum(p)
+                pv = jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                acc_scr[hidx, :] = acc_scr[hidx, :] * corr + pv[0]
+
+    @pl.when(j == pps - 1)
+    def _emit():
+        # lengths >= 1 always (the step's own token), so l > 0
+        out = acc_scr[...] / l_scr[...]
+        o_ref[...] = out.reshape(1, h * hd).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("hd", "pps", "interpret"))
+def _paged_attn(q2, kf, vf, pt_flat, lens, hd: int, pps: int,
+                interpret: bool):
+    """q2 (B, H*hd); kf/vf (num_pages, page_size, KV*hd); pt_flat (B*pps,)
+    int32; lens (B,) int32 -> (B, H*hd)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, dh = q2.shape
+    ps, kvd = kf.shape[1], kf.shape[2]
+    h = dh // hd
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pps),
+        in_specs=[
+            pl.BlockSpec((1, dh), lambda i, j, pt, ln: (i, 0)),
+            pl.BlockSpec((1, ps, kvd),
+                         lambda i, j, pt, ln: (pt[i * pps + j], 0, 0)),
+            pl.BlockSpec((1, ps, kvd),
+                         lambda i, j, pt, ln: (pt[i * pps + j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, dh), lambda i, j, pt, ln: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, hd=hd, ps=ps, pps=pps),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, dh), q2.dtype),
+        interpret=interpret,
+    )(pt_flat, lens, q2, kf, vf)
+
+
+def paged_decode_attention(q, k_pages, v_pages, page_table, lengths):
+    """Ragged single-position attention against a paged pool: q (B, 1, H, hd)
+    per slot; k/v_pages (num_pages, page_size, KV, hd) — ONE layer's shared
+    pool; page_table (B, pages_per_slot) int32 names each slot's pages in
+    logical order (0 = the trash page for unallocated tails); lengths (B,)
+    int32 counts each slot's valid positions INCLUDING the one this step
+    wrote. Returns (B, 1, H, hd) in q's dtype; softmax in fp32.
+
+    Dispatch mirrors the prefill kernels: :func:`decode_plan` (with
+    ``pages=``) earns the Pallas kernel via probe-cache win or
+    ``EDGELLM_ATTN=pallas`` force; otherwise the XLA fallback gathers each
+    slot's span contiguous and reuses :func:`decode_attention` with vector
+    lengths — trash-page garbage lands only in masked positions, where
+    softmax of ``finfo.min`` contributes exactly 0."""
+    b, s1, h, hd = q.shape
+    pn, ps, kv, _ = k_pages.shape
+    pps = page_table.shape[1]
+    span = pps * ps
+    if s1 != 1:
+        raise ValueError(f"paged decode is q_len=1 only, got q_len={s1}")
+    if h % kv:
+        raise ValueError(f"ragged GQA: H={h}, KV={kv}")
+    plan = decode_plan(span, h, kv, hd,
+                       itemsize=jnp.dtype(q.dtype).itemsize,
+                       pages=(pps, ps))
+    if plan is not None:
+        q2 = q.reshape(b, h * hd)
+        kf = k_pages.reshape(pn, ps, kv * hd)
+        vf = v_pages.reshape(pn, ps, kv * hd)
+        out = _paged_attn(q2, kf, vf, page_table.reshape(-1),
+                          lengths.astype(jnp.int32), hd, pps,
+                          _use_interpret())
+        return out.reshape(b, 1, h, hd)
+    idx = (page_table[:, :, None] * ps
+           + jnp.arange(ps)[None, None, :]).reshape(b, span)
+    kg = k_pages.reshape(pn * ps, kv, hd)[idx]
+    vg = v_pages.reshape(pn * ps, kv, hd)[idx]
+    return decode_attention(q, kg, vg, lengths)

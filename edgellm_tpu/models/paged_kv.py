@@ -1,0 +1,505 @@
+"""Page-table KV cache for continuous batching.
+
+The monolithic :class:`~edgellm_tpu.models.transformer.KVCache` gives every
+request a private ``(B, capacity)`` buffer sized for the worst case, so a
+mixed-length request stream either pads every cache to the longest stream or
+recompiles per shape — ROADMAP item 1's gap between "a compiled generate()"
+and "a service". This module replaces the monolith with the paged layout of
+*Ragged Paged Attention* (PAPERS.md): one shared pool of fixed-size pages,
+
+    k, v: (L, num_pages, page_size, KV, hd)
+
+and a small host-side allocator that maps each stream (a *slot*) to an
+ordered list of pages. Logical position ``p`` of slot ``i`` lives at
+``page_table[i, p // page_size]`` offset ``p % page_size``. The page table
+and per-slot lengths ride through the jitted step as traced int32 arrays, so
+ONE executable serves every admit/evict/fill configuration of a given pool
+geometry — the continuous-batching scheduler (``serve/batching.py``) admits
+and evicts mid-flight without a single retrace.
+
+Conventions that keep the paged step bit-identical to the contiguous one:
+
+- page 0 is the TRASH page: never allocated, written by inactive slots (their
+  page-table rows are all zero). Its contents are garbage but always finite
+  (inactive rows run real token-0 math), so masked attention positions
+  contribute exactly 0 to every softmax.
+- pages store POST-ROTARY keys at ``num_kv_heads`` width, the same values the
+  contiguous cache stores; gathering a slot's pages in order reproduces that
+  slot's contiguous cache prefix byte-for-byte.
+- the per-slot RoPE row, attention mask, and sampling fold_in sequence match
+  ``decode_step``/``generate`` exactly, and attention softmax is invariant to
+  the amount of masked padding — so a slot's tokens are bit-identical to
+  running it alone (``tests/test_batching.py`` asserts this, and the
+  ``batching.decode-step-identity`` graphlint contract re-checks it on every
+  lint run).
+
+Donation: the jitted step and adopt/defrag helpers donate the pool buffers,
+so the (L, num_pages, page_size) arrays update in place — the
+``paged.decode_step`` graph contract asserts the aliasing survives lowering.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..lint import graph_contract
+from .configs import ModelConfig
+from .transformer import (_cast_params, _layernorm, _rmsnorm, _rotate_half,
+                          embed, mlp, precompute_rope, unembed)
+
+#: slot id a page belongs to when it is on the free list
+FREE = -1
+
+
+class OutOfPages(RuntimeError):
+    """The pool has no free page for a slot that must grow — the scheduler's
+    signal to evict (or refuse to admit) a stream."""
+
+
+class OutOfSlots(RuntimeError):
+    """Every slot of the compiled step shape is occupied."""
+
+
+class PagePool(NamedTuple):
+    """Device-side page pool: post-rotary K/V at ``num_kv_heads`` width.
+
+    k, v: (L, num_pages, page_size, KV, hd). Page 0 is the reserved trash
+    page (see module docstring)."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_pool(cfg: ModelConfig, num_pages: int, page_size: int,
+              dtype=jnp.float32) -> PagePool:
+    """An all-zero pool; ``num_pages`` INCLUDES the reserved trash page 0,
+    so ``num_pages - 1`` pages are allocatable."""
+    if num_pages < 2:
+        raise ValueError(f"num_pages must be >= 2 (page 0 is reserved), "
+                         f"got {num_pages}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads,
+             cfg.head_dim)
+    return PagePool(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# jitted pool surgery: adopt a contiguous prefix, gather one back, permute
+# pages for defrag. All donate the pool so surgery is in-place.
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _adopt_impl(pool_k, pool_v, k_seq, v_seq, dest):
+    """Scatter a contiguous (L, S, KV, hd) K/V prefix into the pool rows
+    named by ``dest`` (S,) — flat indices into the (num_pages * page_size)
+    token axis. S is static per call (one executable per adopted length)."""
+    l, pn, ps = pool_k.shape[:3]
+    tail = pool_k.shape[3:]
+    fk = pool_k.reshape(l, pn * ps, *tail).at[:, dest].set(
+        k_seq.astype(pool_k.dtype))
+    fv = pool_v.reshape(l, pn * ps, *tail).at[:, dest].set(
+        v_seq.astype(pool_v.dtype))
+    return fk.reshape(pool_k.shape), fv.reshape(pool_v.shape)
+
+
+@jax.jit
+def _gather_impl(pool_k, pool_v, idx):
+    """Read the pool rows named by ``idx`` (span,) back as contiguous
+    (L, span, KV, hd) arrays — the checkpoint/eviction serialization path."""
+    l, pn, ps = pool_k.shape[:3]
+    tail = pool_k.shape[3:]
+    return (pool_k.reshape(l, pn * ps, *tail)[:, idx],
+            pool_v.reshape(l, pn * ps, *tail)[:, idx])
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _permute_impl(pool_k, pool_v, src):
+    """new_pool[p] = old_pool[src[p]] — the defrag move, one gather."""
+    return pool_k[:, src], pool_v[:, src]
+
+
+class PagedKVCache:
+    """Host-side allocator + device pool for up to ``max_slots`` concurrent
+    streams of up to ``pages_per_slot * page_size`` tokens each.
+
+    The device state is ``self.pool`` (swapped wholesale after each donated
+    step/adopt/defrag); the host state is the page table, per-slot lengths,
+    the free list, and per-page ownership. ``device_tables()`` materializes
+    the traced int32 inputs of the compiled step. All mutating methods keep
+    :meth:`check_invariants` true: no page owned twice, no page leaked, the
+    trash page never allocated.
+    """
+
+    def __init__(self, cfg: ModelConfig, *, num_pages: int, page_size: int,
+                 max_slots: int, pages_per_slot: int, dtype=jnp.float32,
+                 materialize: bool = True):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if pages_per_slot < 1:
+            raise ValueError(
+                f"pages_per_slot must be >= 1, got {pages_per_slot}")
+        self.cfg = cfg
+        # materialize=False: bookkeeping-only mode — the page table, free
+        # list, and ownership machinery without a local device pool. The
+        # split runtime uses this: its pools live per-stage on the mesh
+        # (SplitRuntime.init_paged_pool), only the allocator is shared.
+        self.pool = (init_pool(cfg, num_pages, page_size, dtype)
+                     if materialize else None)
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_slots = max_slots
+        self.pages_per_slot = pages_per_slot
+        self.page_table = np.zeros((max_slots, pages_per_slot), np.int32)
+        self.lengths = np.zeros((max_slots,), np.int32)
+        self.active = np.zeros((max_slots,), bool)
+        # LIFO free list, low pages first out — deterministic layouts
+        self._free = list(range(num_pages - 1, 0, -1))
+        self._slot_pages: list[list[int]] = [[] for _ in range(max_slots)]
+        self._owner = np.full((num_pages,), FREE, np.int32)  # page -> slot
+
+    # -- geometry ----------------------------------------------------------
+
+    @property
+    def span(self) -> int:
+        """Max positions one slot can hold — the compiled attention width."""
+        return self.pages_per_slot * self.page_size
+
+    @property
+    def num_free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def token_capacity(self) -> int:
+        """Allocatable token positions (the trash page excluded)."""
+        return (self.num_pages - 1) * self.page_size
+
+    @property
+    def live_tokens(self) -> int:
+        return int(self.lengths[self.active].sum())
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size)
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def alloc_slot(self) -> int:
+        """Claim the lowest free slot (deterministic admit order)."""
+        for s in range(self.max_slots):
+            if not self.active[s]:
+                self.active[s] = True
+                self.lengths[s] = 0
+                return s
+        raise OutOfSlots(f"all {self.max_slots} slots active")
+
+    def ensure(self, slot: int, new_length: int) -> None:
+        """Grow ``slot``'s page list to cover ``new_length`` positions,
+        allocating pages from the free list. Raises :class:`OutOfPages`
+        (allocating nothing) when the pool cannot cover the growth."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        if new_length > self.span:
+            raise ValueError(f"length {new_length} exceeds slot span "
+                             f"{self.span}")
+        need = self.pages_for(new_length) - len(self._slot_pages[slot])
+        if need <= 0:
+            return
+        if need > len(self._free):
+            raise OutOfPages(
+                f"slot {slot} needs {need} page(s), {len(self._free)} free")
+        for _ in range(need):
+            p = self._free.pop()
+            self._owner[p] = slot
+            self.page_table[slot, len(self._slot_pages[slot])] = p
+            self._slot_pages[slot].append(p)
+
+    def free_slot(self, slot: int) -> None:
+        """Release a slot and return its pages (reverse allocation order, so
+        the free list stays LIFO-deterministic). The page contents are left
+        stale — masked attention never reads past a slot's length."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        for p in reversed(self._slot_pages[slot]):
+            self._owner[p] = FREE
+            self._free.append(p)
+        self._slot_pages[slot] = []
+        self.page_table[slot] = 0
+        self.lengths[slot] = 0
+        self.active[slot] = False
+
+    def device_tables(self) -> tuple[jnp.ndarray, jnp.ndarray]:
+        """(page_table (max_slots, pages_per_slot), lengths (max_slots,)) as
+        device int32 arrays — the traced inputs of the compiled step."""
+        return (jnp.asarray(self.page_table),
+                jnp.asarray(self.lengths, jnp.int32))
+
+    # -- data movement -----------------------------------------------------
+
+    def _require_pool(self, what: str) -> None:
+        if self.pool is None:
+            raise ValueError(f"{what} needs a materialized pool; this cache "
+                             f"was built with materialize=False "
+                             f"(bookkeeping-only)")
+
+    def _flat_indices(self, slot: int, n: int) -> np.ndarray:
+        pos = np.arange(n)
+        return (self.page_table[slot, pos // self.page_size]
+                * self.page_size + pos % self.page_size).astype(np.int32)
+
+    def adopt(self, slot: int, k_seq, v_seq, length: int) -> None:
+        """Write a contiguous (L, length, KV, hd) post-rotary K/V prefix
+        (a prefill's cache, or a restored checkpoint) into ``slot``'s pages
+        and set its length. Allocates pages as needed."""
+        self._require_pool("adopt")
+        self.ensure(slot, length)
+        dest = jnp.asarray(self._flat_indices(slot, length))
+        k, v = _adopt_impl(self.pool.k, self.pool.v, k_seq, v_seq, dest)
+        self.pool = PagePool(k, v)
+        self.lengths[slot] = length
+
+    def gather_slot(self, slot: int) -> dict:
+        """Read ``slot``'s K/V back as the contiguous host state dict the
+        recovery checkpoint stores: {"k": (L, length, KV, hd), "v": ...,
+        "length"} — byte-identical to the contiguous cache prefix."""
+        self._require_pool("gather_slot")
+        n = int(self.lengths[slot])
+        idx = jnp.asarray(self._flat_indices(slot, max(n, 1)))
+        k, v = _gather_impl(self.pool.k, self.pool.v, idx)
+        return {"k": np.asarray(k)[:, :n], "v": np.asarray(v)[:, :n],
+                "length": np.asarray(n, np.int32)}
+
+    def defrag(self) -> int:
+        """Compact allocated pages to the low end of the pool (slot order,
+        trash page fixed at 0) and rebuild the free list above them. Returns
+        the number of pages that moved. One donated device gather; page
+        tables are rewritten to match, so every slot's logical content is
+        unchanged."""
+        self._require_pool("defrag")
+        mapping = np.arange(self.num_pages, dtype=np.int32)  # old -> new
+        nxt = 1
+        for s in range(self.max_slots):
+            for p in self._slot_pages[s]:
+                mapping[p] = nxt
+                nxt += 1
+        moved = 0
+        src = np.zeros((self.num_pages,), np.int32)  # new -> old
+        for old in range(self.num_pages):
+            src[mapping[old]] = old
+        for s in range(self.max_slots):
+            pages = self._slot_pages[s]
+            for j, p in enumerate(pages):
+                if mapping[p] != p:
+                    moved += 1
+                pages[j] = int(mapping[p])
+                self.page_table[s, j] = pages[j]
+                self._owner[pages[j]] = s
+        for p in range(nxt, self.num_pages):
+            self._owner[p] = FREE
+        self._free = list(range(self.num_pages - 1, nxt - 1, -1))
+        if moved:
+            k, v = _permute_impl(self.pool.k, self.pool.v, jnp.asarray(src))
+            self.pool = PagePool(k, v)
+        return moved
+
+    # -- serialization -----------------------------------------------------
+
+    def state_dict(self) -> dict:
+        """Whole-cache snapshot as host numpy arrays — the checkpoint form.
+        (Per-slot checkpoints use :meth:`gather_slot` instead, which is
+        geometry-independent.)"""
+        self._require_pool("state_dict")
+        return {"k": np.asarray(self.pool.k), "v": np.asarray(self.pool.v),
+                "page_table": self.page_table.copy(),
+                "lengths": self.lengths.copy(),
+                "active": self.active.copy(),
+                "free": np.asarray(self._free, np.int32)}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore :meth:`state_dict` output bit-exactly (same geometry)."""
+        self._require_pool("load_state_dict")
+        if state["k"].shape != self.pool.k.shape:
+            raise ValueError(
+                f"pool shape mismatch: checkpoint {state['k'].shape} vs "
+                f"cache {self.pool.k.shape}")
+        self.pool = PagePool(jnp.asarray(state["k"]),
+                             jnp.asarray(state["v"]))
+        self.page_table = np.asarray(state["page_table"], np.int32).copy()
+        self.lengths = np.asarray(state["lengths"], np.int32).copy()
+        self.active = np.asarray(state["active"], bool).copy()
+        self._free = [int(p) for p in state["free"]]
+        self._owner = np.full((self.num_pages,), FREE, np.int32)
+        self._slot_pages = [[] for _ in range(self.max_slots)]
+        for s in range(self.max_slots):
+            if not self.active[s]:
+                continue
+            n = self.pages_for(int(self.lengths[s]))
+            self._slot_pages[s] = [int(p) for p in self.page_table[s, :n]]
+            for p in self._slot_pages[s]:
+                self._owner[p] = s
+
+    # -- invariants --------------------------------------------------------
+
+    def check_invariants(self) -> None:
+        """Raise AssertionError on any aliasing/leak/ownership violation —
+        the test suite calls this after every mutation."""
+        assert 0 not in self._free, "trash page 0 on the free list"
+        assert self._owner[0] == FREE, "trash page 0 owned by a slot"
+        owned = [p for pages in self._slot_pages for p in pages]
+        assert len(owned) == len(set(owned)), \
+            f"page owned twice: {sorted(owned)}"
+        assert not (set(owned) & set(self._free)), "page both owned and free"
+        assert set(owned) | set(self._free) == set(range(1, self.num_pages)), \
+            "page leaked (neither owned nor free)"
+        for s in range(self.max_slots):
+            if self.active[s]:
+                assert len(self._slot_pages[s]) * self.page_size >= \
+                    self.lengths[s], f"slot {s} pages do not cover its length"
+                for j, p in enumerate(self._slot_pages[s]):
+                    assert self._owner[p] == s
+                    assert self.page_table[s, j] == p
+            else:
+                assert not self._slot_pages[s], f"inactive slot {s} owns pages"
+                assert (self.page_table[s] == 0).all()
+                assert self.lengths[s] == 0
+
+
+# ---------------------------------------------------------------------------
+# the ragged decode step: one position for EVERY slot, per-slot positions,
+# one compiled executable per pool geometry.
+# ---------------------------------------------------------------------------
+
+
+def _apply_rotary_rows(x: jnp.ndarray, cos_b: jnp.ndarray,
+                       sin_b: jnp.ndarray, rot: int) -> jnp.ndarray:
+    """``apply_rotary`` with a PER-SLOT table row: x (B, 1, H, hd), cos/sin
+    (B, rot) gathered at each slot's own position. Elementwise ops and
+    values match the contiguous path's single sliced row exactly."""
+    c = cos_b[:, None, None, :].astype(x.dtype)
+    s = sin_b[:, None, None, :].astype(x.dtype)
+    if rot == x.shape[-1]:
+        return x * c + _rotate_half(x) * s
+    x_rot, x_pass = x[..., :rot], x[..., rot:]
+    x_rot = x_rot * c + _rotate_half(x_rot) * s
+    return jnp.concatenate([x_rot, x_pass], axis=-1)
+
+
+def _attention_decode_paged(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+                            cos_b, sin_b, k_pages, v_pages,
+                            page_table, lengths,
+                            tp_axis: Optional[str] = None):
+    """The paged twin of ``transformer._attention_decode``: project the
+    (B, 1, D) hidden, rotate each slot at ITS position, scatter the new K/V
+    into each slot's current page, then ragged-attend against the gathered
+    pages. k/v_pages are ONE layer's (num_pages, page_size, KV, hd) pool."""
+    b, s1, d = x.shape
+    hd = cfg.head_dim
+    h, kv = lp["wq"].shape[-1] // hd, lp["wk"].shape[-1] // hd
+    q = (x @ lp["wq"]).reshape(b, s1, h, hd)
+    k = (x @ lp["wk"]).reshape(b, s1, kv, hd)
+    v = (x @ lp["wv"]).reshape(b, s1, kv, hd)
+    if "bq" in lp:
+        q = q + lp["bq"].reshape(h, hd)
+        k = k + lp["bk"].reshape(kv, hd)
+        v = v + lp["bv"].reshape(kv, hd)
+    q = _apply_rotary_rows(q, cos_b, sin_b, cfg.rotary_dim)
+    k = _apply_rotary_rows(k, cos_b, sin_b, cfg.rotary_dim)
+    pn, ps = k_pages.shape[0], k_pages.shape[1]
+    # slot i's new token lands in its (length // page_size)-th page at offset
+    # length % page_size; inactive slots (all-zero table rows) land in the
+    # trash page, where duplicate scatter indices are harmless garbage
+    dest = (page_table[jnp.arange(b), lengths // ps] * ps
+            + lengths % ps)  # (B,)
+    tail = k_pages.shape[2:]
+    k_pages = k_pages.reshape(pn * ps, *tail).at[dest].set(
+        k[:, 0].astype(k_pages.dtype)).reshape(pn, ps, *tail)
+    v_pages = v_pages.reshape(pn * ps, *tail).at[dest].set(
+        v[:, 0].astype(v_pages.dtype)).reshape(pn, ps, *tail)
+
+    from .flash_attention import paged_decode_attention
+
+    out = paged_decode_attention(q, k_pages, v_pages, page_table, lengths + 1)
+    out = out.reshape(b, s1, h * hd) @ lp["wo"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    if "bo" in lp:
+        out = out + lp["bo"]
+    return out, k_pages, v_pages
+
+
+def block_decode_paged(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray,
+                       cos_b, sin_b, k_pages, v_pages, page_table, lengths,
+                       tp_axis: Optional[str] = None):
+    """The paged twin of ``transformer.block_decode`` for one layer:
+    same norm/residual/MLP structure, paged attention core."""
+    if cfg.family == "gpt_neox":
+        attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"],
+                             cfg.norm_eps)
+        attn_out, k_pages, v_pages = _attention_decode_paged(
+            cfg, lp, attn_in, cos_b, sin_b, k_pages, v_pages,
+            page_table, lengths, tp_axis)
+        mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"],
+                            cfg.norm_eps)
+        return (hidden + attn_out + mlp(cfg, lp, mlp_in, tp_axis),
+                k_pages, v_pages)
+    attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
+    attn_out, k_pages, v_pages = _attention_decode_paged(
+        cfg, lp, attn_in, cos_b, sin_b, k_pages, v_pages,
+        page_table, lengths, tp_axis)
+    hidden = hidden + attn_out
+    mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
+    return hidden + mlp(cfg, lp, mlp_in, tp_axis), k_pages, v_pages
+
+
+@graph_contract("paged.decode_step", collectives={},
+                donate=lambda ctx: ctx.get("donate_min", 2))
+def paged_decode_step(cfg: ModelConfig, params: dict,
+                      pool_k: jnp.ndarray, pool_v: jnp.ndarray,
+                      page_table: jnp.ndarray, lengths: jnp.ndarray,
+                      token_ids: jnp.ndarray, *,
+                      compute_dtype: Optional[jnp.dtype] = None):
+    """Append one position to EVERY slot of a paged pool in one pass.
+
+    pool_k/pool_v: (L, num_pages, page_size, KV, hd); page_table
+    (max_slots, pages_per_slot) and lengths (max_slots,) are TRACED — one
+    executable per pool geometry serves every admit/evict/fill state.
+    token_ids: (max_slots,) int32 (inactive slots pass any valid token; their
+    writes land in the trash page). Returns (logits (max_slots, V) fp32,
+    pool_k, pool_v).
+
+    Per-slot positions: the RoPE row, the page write offset, and the
+    attention mask all index by each slot's own ``lengths[i]`` — the ragged
+    generalization of ``decode_step``'s single ``cache.length``; per-slot
+    math is bit-identical to the contiguous path (see module docstring).
+    """
+    params = _cast_params(params, compute_dtype)
+    if token_ids.ndim == 1:
+        token_ids = token_ids[:, None]
+    hidden = embed(params, token_ids)  # (B, 1, D)
+    span = page_table.shape[1] * pool_k.shape[2]  # pages_per_slot * page_size
+    cos, sin = precompute_rope(cfg, span)
+    cos_b = cos[lengths]  # (B, rot) — each slot's own row
+    sin_b = sin[lengths]
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        h, kp, vp = block_decode_paged(cfg, lp, h, cos_b, sin_b, kp, vp,
+                                       page_table, lengths)
+        return h, (kp, vp)
+
+    hidden, (k_new, v_new) = jax.lax.scan(
+        body, hidden, (params["layers"], pool_k, pool_v))
+    logits = unembed(cfg, params, hidden)[:, -1]  # (B, V) fp32
+    return logits, k_new, v_new

@@ -37,11 +37,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.configs import ModelConfig
 from ..models.transformer import (block, block_decode, embed, unembed,
                                   precompute_rope, KVCache)
+from ..models.paged_kv import block_decode_paged
 from ..codecs.packing import get_wire_codec, WireCodec
 from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
 from ..lint import graph_contract
 from ..serve.recovery import StageLostError
 from ..utils.jax_compat import shard_map, pcast_varying
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _adopt_paged_impl(pool_k, pool_v, k_seq, v_seq, dest):
+    """Scatter one stream's (n_stages, sz, n, KV, hd) prefill K/V into the
+    per-stage pools at flat token indices ``dest``. Donated in-place update;
+    elementwise along "stage", so the pool sharding propagates hop-free."""
+    ns, sz, pn, ps = pool_k.shape[:4]
+    tail = pool_k.shape[4:]
+    flat_k = pool_k.reshape(ns, sz, pn * ps, *tail)
+    flat_v = pool_v.reshape(ns, sz, pn * ps, *tail)
+    flat_k = flat_k.at[:, :, dest].set(k_seq.astype(flat_k.dtype))
+    flat_v = flat_v.at[:, :, dest].set(v_seq.astype(flat_v.dtype))
+    return (flat_k.reshape(pool_k.shape), flat_v.reshape(pool_v.shape))
 
 
 def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
@@ -351,6 +366,7 @@ class SplitRuntime:
                     f"(n_data={mesh.shape['data']}); use per-token codecs or n_data=1")
         self._forward = self._build_forward()
         self._decode_fns_cache: dict = {}  # capacity -> (prefill_fn, step_fn)
+        self._paged_fns_cache: dict = {}   # pool geometry -> step_fn
 
     # ---------- stage liveness ----------
 
@@ -791,6 +807,169 @@ class SplitRuntime:
         """Measured payload bytes per hop for ONE decode step's (batch, 1, D)
         boundary activation — bytes/token is this divided by ``batch``."""
         return hop_payload_bytes(self.codecs, self.cfg, batch, 1)
+
+    # ---------- paged incremental decode ----------
+    #
+    # The continuous-batching twin of the block above: per-stage KV caches
+    # page exactly like serve/batching's local pools (fixed-size pages, a
+    # traced page table, trash page 0), so streams with different prompt
+    # lengths and fill levels share ONE compiled ragged step per pool
+    # geometry while every cut still moves its quantized (B, 1, D) boundary
+    # activation.  Pool layout: (n_stages, sz, num_pages, page_size, KV, hd)
+    # sharded P("stage") — each stage owns its own layers' pages, pages never
+    # cross a cut.
+
+    def init_paged_pool(self, num_pages: int, page_size: int,
+                        dtype=jnp.float32) -> dict:
+        """Zeroed per-stage paged KV pools, placed sharded on "stage".
+        Page 0 is the trash page (see models.paged_kv) — host-side page
+        tables must never hand it out."""
+        self._check_decode_supported()
+        if num_pages < 2:
+            raise ValueError("need num_pages >= 2 (page 0 is the trash page)")
+        cfg = self.cfg
+        shape = (self.split.n_stages, self.stage_size, num_pages, page_size,
+                 cfg.num_kv_heads, cfg.head_dim)
+        sh = NamedSharding(self.mesh, P("stage"))
+        zeros = functools.partial(jax.jit, static_argnums=0,
+                                  out_shardings=sh)(
+            lambda s: jnp.zeros(s, dtype))
+        return {"k": zeros(shape), "v": zeros(shape)}
+
+    def adopt_paged(self, pool: dict, cache: dict, row: int,
+                    dest: np.ndarray, length: int) -> dict:
+        """Move one stream's prefilled contiguous cache (``prefill_decode``
+        row ``row``) into pool pages at flat token indices ``dest``
+        ((length,) int32, from PagedKVCache._flat_indices). Donates the pool
+        buffers — the scatter is stage-elementwise, no collectives."""
+        dest = jnp.asarray(dest, jnp.int32)
+        k_seq = cache["k"][:, :, row, :length]   # (n_stages, sz, n, KV, hd)
+        v_seq = cache["v"][:, :, row, :length]
+        pk, pv = _adopt_paged_impl(pool["k"], pool["v"], k_seq, v_seq, dest)
+        return {"k": pk, "v": pv}
+
+    def _paged_decode_fns(self, num_pages: int, page_size: int):
+        """Build (or fetch) the jitted ragged step executable for one pool
+        geometry. Page table and lengths are TRACED — one executable per
+        (num_pages, page_size, max_slots, pages_per_slot) shape serves every
+        admit/evict/fill state (the jit-miss-free property batching relies
+        on)."""
+        key = ("paged", num_pages, page_size)
+        if key in self._paged_fns_cache:
+            return self._paged_fns_cache[key]
+        cfg, n_stages, sz = self.cfg, self.split.n_stages, self.stage_size
+        codecs, mesh = self.codecs, self.mesh
+        layer_pspec = self._layer_pspec
+        link = self._link
+
+        def _hop_protocol(run_stage, hidden, carry, fault_key):
+            if link is None:
+                out, c = run_pipeline_stages_carry(
+                    n_stages, codecs, run_stage, hidden, carry)
+                return out, c, None
+            return run_pipeline_stages_carry(
+                n_stages, codecs, run_stage, hidden, carry,
+                link=link, fault_key=fault_key)
+
+        def stage_step_paged(local_layers, local_valid, hidden, kp_loc,
+                             vp_loc, page_table, lengths, cos_b, sin_b):
+            lv = {k: v[0] for k, v in local_layers.items()}
+            valid = local_valid[0]
+            hidden = pcast_varying(hidden, ("stage",))
+
+            def scan_body(h, xs):
+                lp, ok, kp, vp = xs
+                out, kp2, vp2 = block_decode_paged(
+                    cfg, lp, h, cos_b, sin_b, kp, vp, page_table, lengths)
+                # padding layers are identity AND must not touch their pages
+                return jnp.where(ok, out, h), (jnp.where(ok, kp2, kp),
+                                               jnp.where(ok, vp2, vp))
+
+            def run_stage(h, cache):
+                kp, vp = cache
+                h2, (kp2, vp2) = jax.lax.scan(scan_body, h,
+                                              (lv, valid, kp, vp))
+                return h2, (kp2, vp2)
+
+            # the deepest slot's fill level keys the fault step: distinct as
+            # decoding advances, identical across same-seed replays of the
+            # same admit/evict schedule
+            fkey = None if link is None else jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(link.faults.seed), 0x57E9),
+                jnp.max(lengths))
+            out, (kp, vp), counters = _hop_protocol(
+                run_stage, hidden, (kp_loc[0], vp_loc[0]), fkey)
+            if link is None:
+                return out, kp[None], vp[None]
+            return out, kp[None], vp[None], counters
+
+        # pools are donated: every ragged step scatters in place, same
+        # aliasing discipline the "split.decode_step_paged" contract asserts
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step_paged_fn(placed, pool_k, pool_v, page_table, lengths,
+                          token_ids):
+            hidden = embed(placed, token_ids[:, None])  # (B, 1, D)
+            span = page_table.shape[1] * page_size
+            cos, sin = precompute_rope(cfg, span)
+            cos_b = cos[lengths]  # (B, rot) — each slot's own position
+            sin_b = sin[lengths]
+            lspecs = {k: layer_pspec(k, v.ndim)
+                      for k, v in placed["layers"].items()}
+            if link is None:
+                out, kp, vp = shard_map(
+                    stage_step_paged, mesh=mesh,
+                    in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                              P(), P(), P(), P()),
+                    out_specs=(P(), P("stage"), P("stage")),
+                    check_vma=False,
+                )(placed["layers"], placed["layers_valid"], hidden,
+                  pool_k, pool_v, page_table, lengths, cos_b, sin_b)
+                return unembed(cfg, placed, out)[:, -1], kp, vp
+            out, kp, vp, counters = shard_map(
+                stage_step_paged, mesh=mesh,
+                in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                          P(), P(), P(), P()),
+                out_specs=(P(), P("stage"), P("stage"), P()),
+                check_vma=False,
+            )(placed["layers"], placed["layers_valid"], hidden,
+              pool_k, pool_v, page_table, lengths, cos_b, sin_b)
+            return unembed(cfg, placed, out)[:, -1], kp, vp, counters
+
+        self._paged_fns_cache[key] = step_paged_fn
+        return step_paged_fn
+
+    @graph_contract(
+        "split.decode_step_paged",
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"],
+        donate=lambda ctx: ctx.get("donate_min", 2))
+    def decode_step_paged(self, placed_params: dict, pool: dict,
+                          page_table: jnp.ndarray, lengths: jnp.ndarray,
+                          token_ids: jnp.ndarray) -> tuple:
+        """One ragged decode position across the pipeline: every active slot
+        advances at its OWN fill level; each cut quantizes the single-token
+        hidden batch through its wire codec. page_table (max_slots,
+        pages_per_slot) / lengths (max_slots,) come from a host-side
+        PagedKVCache (cache_dim=... n/a — the host object tracks pages, this
+        runs the math). Returns (logits (max_slots, V) fp32, updated pool).
+        Per-slot tokens are bit-identical to :meth:`decode_step` at the same
+        position (tests/test_batching.py asserts it end to end)."""
+        self._check_alive()
+        self._check_decode_supported()
+        num_pages, page_size = pool["k"].shape[2], pool["k"].shape[3]
+        step_fn = self._paged_decode_fns(int(num_pages), int(page_size))
+        page_table = jnp.asarray(page_table, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        if self._link is None:
+            logits, pk, pv = step_fn(placed_params, pool["k"], pool["v"],
+                                     page_table, lengths, token_ids)
+        else:
+            logits, pk, pv, counters = step_fn(
+                placed_params, pool["k"], pool["v"], page_table, lengths,
+                token_ids)
+            self._counter_accum.append(counters)
+        return logits, {"k": pk, "v": pv}
 
     # ---------- accounting ----------
 

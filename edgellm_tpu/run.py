@@ -113,6 +113,7 @@ _PARAM_KEYS = {
     "link_health": "split/serve",
     "deadline": "split", "stage_failure": "split", "recovery": "split",
     "serving": "serve",
+    "batching": "serve",
     "max_compiles": "distances",
     "observability": "all",
 }
@@ -167,6 +168,11 @@ def _validate_params_json(p: dict) -> None:
         die("deadline/stage_failure/recovery only apply to experiment 'split'")
     if exp != "serve" and "serving" in p:
         die("serving only applies to experiment 'serve'")
+    if exp != "serve" and "batching" in p:
+        die("batching only applies to experiment 'serve'")
+    if "batching" in p and "cuts" in p:
+        die("batching drives the local paged pool; the split pipeline serves "
+            "through the soak path — drop 'batching' or 'cuts'")
     for k in _REQUIRED.get(exp, ()):
         if k not in p:
             die(f"experiment {exp!r} requires key {k!r}")
@@ -338,6 +344,29 @@ def _validate_params_json(p: dict) -> None:
         if ks is not None and "cuts" in p and ks > len(p["cuts"]):
             die(f"serving.soak.kill_stage {ks} out of range for "
                 f"{len(p['cuts']) + 1} pipeline stage(s)")
+    if "batching" in p:
+        from .serve.batching import BatchingConfig
+
+        b = p["batching"]
+        if not isinstance(b, dict):
+            die(f"batching must be an object of BatchingConfig fields, "
+                f"got {b!r}")
+        # dtype fields are runtime objects, not JSON — keep them out of the
+        # schema so a typo'd key dies with the real field list
+        fields = {f.name for f in dataclasses.fields(BatchingConfig)} \
+            - {"compute_dtype", "cache_dtype"}
+        bad = sorted(set(b) - fields)
+        if bad:
+            die(f"batching: unknown field(s) {bad}; known: {sorted(fields)}")
+        try:
+            bcfg = BatchingConfig(**b)
+        except (TypeError, ValueError) as e:
+            die(f"batching: {e}")
+        sk = (p.get("serving", {}).get("soak") or {})
+        need = (sk.get("prompt_len", 8) + sk.get("max_new_tokens", 8) - 1)
+        if need > bcfg.span:
+            die(f"batching: soak requests need {need} cache positions > slot "
+                f"span {bcfg.span} (pages_per_slot x page_size)")
 
 
 def _serve_front_config(sv: dict):
@@ -631,6 +660,55 @@ def main(argv=None) -> int:
             front_cfg = _serve_front_config(sv)
             soak = SoakConfig(**sv.get("soak", {}))
             clock = FakeClock()
+            if "batching" in params_json:
+                # continuous-batching path: the front routes every admitted
+                # request through ONE paged batcher event loop instead of
+                # serial per-request generate calls (REPRODUCING §13)
+                from .serve.batching import BatchingConfig, ContinuousBatcher
+                from .serve.frontend import Request
+
+                bcfg = BatchingConfig(**params_json["batching"])
+                batcher = ContinuousBatcher(cfg, params, bcfg)
+                front = ServeFront(cfg, params, config=front_cfg,
+                                   clock=clock, batcher=batcher)
+                # warm the ragged step + the soak's prefill shape so compile
+                # time never lands on a request's service clock
+                warm = ContinuousBatcher(cfg, params, bcfg)
+                warm.submit(np.ones((soak.prompt_len,), np.int32), 2)
+                warm.run()
+                rng = np.random.default_rng(soak.seed)
+                gaps = rng.exponential(1.0 / soak.arrival_rate,
+                                       size=soak.n_requests)
+                for i in range(soak.n_requests):
+                    clock.advance(float(gaps[i]))
+                    front.submit(Request(
+                        prompt_ids=rng.integers(
+                            1, cfg.vocab_size,
+                            size=soak.prompt_len).astype(np.int32),
+                        max_new_tokens=soak.max_new_tokens,
+                        temperature=soak.temperature,
+                        deadline_s=soak.deadline_s, rng_seed=i))
+                records = front.drain_batched()
+                rep = batcher.report()
+                outcomes: dict = {}
+                for rec in records:
+                    outcomes[rec.outcome] = outcomes.get(rec.outcome, 0) + 1
+                artifact = {"requests": len(records), "outcomes": outcomes,
+                            "batcher": rep,
+                            "records": [r.as_dict() for r in records]}
+                with open(out("serve_report.json"), "w") as f:
+                    json.dump(artifact, f, indent=1, default=float)
+                print(json.dumps({
+                    "requests": len(records), "outcomes": outcomes,
+                    "batched_steps": rep["steps"],
+                    "jit_misses": rep["jit_misses"],
+                    "occupancy_mean": round(rep["alloc_util_mean"], 4),
+                    "decode_tokens_per_s": round(
+                        rep["decode_tokens_per_s"], 3),
+                    "artifact": out("serve_report.json")}))
+                if args.serve_report:
+                    _print_serve_report(front.report())
+                return 0
             rt = None
             link_health = None
             if "cuts" in params_json:

@@ -1,3 +1,5 @@
+from .batching import (BatchingConfig, ContinuousBatcher,
+                       batched_step_cache_size)
 from .decode import (decode_step_cache_size, generate, generate_split,
                      resume_split)
 from .frontend import Request, RequestRecord, ServeFront, ServeFrontConfig
@@ -23,4 +25,5 @@ __all__ = [
     "RetryBudget", "RetryBudgetConfig", "RetryBudgetExhausted",
     "ServeFrontConfigError",
     "SoakConfig", "run_soak",
+    "BatchingConfig", "ContinuousBatcher", "batched_step_cache_size",
 ]

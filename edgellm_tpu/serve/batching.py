@@ -1,0 +1,480 @@
+"""Continuous batching over the paged KV cache: one compiled ragged step.
+
+``serve/decode.py``'s ``generate`` runs ONE request shape per call — mixed
+traffic pads to the worst case or recompiles, ROADMAP item 1's gap. This
+module schedules many streams through ONE jitted decode step built on
+``models/paged_kv.py``:
+
+- a fixed pool of ``max_slots`` slots rides through
+  :func:`~edgellm_tpu.models.paged_kv.paged_decode_step` every step; the page
+  table, per-slot lengths, last tokens, RNG keys, step indices and
+  temperatures are all TRACED inputs, so admitting, evicting, finishing or
+  growing a stream never retraces — the steady state is jit-miss-free by
+  construction and :func:`batched_step_cache_size` exposes the counter so
+  tests assert it;
+- prompts are prefetched through the SAME ``_prefill_jit`` executable
+  ``generate`` uses, the first token sampled with the same ``fold_in(key, 0)``
+  — then the prompt's KV is adopted into the stream's pages;
+- sampling inside the batched step reproduces ``decode._sample`` per slot
+  bitwise: ``fold_in`` and ``categorical`` are vmapped over per-slot
+  (key, step) pairs, greedy rows select the argmax lane — so every stream's
+  tokens are bit-identical to running it alone through ``generate`` (the
+  ``batching.decode-step-identity`` graphlint contract re-proves this on
+  every lint run);
+- when the pool runs out of pages the youngest running stream is evicted:
+  its pages are gathered back to a contiguous host prefix (byte-identical to
+  a contiguous cache) and the stream re-queues; re-admission adopts the
+  prefix instead of re-prefilling, and the resumed tokens are bit-identical
+  because the per-step keys depend only on (stream key, step index);
+- eviction payloads round-trip through
+  :class:`~edgellm_tpu.serve.recovery.DecodeCheckpoint` when a
+  ``checkpoint_dir`` is configured, so a killed batcher restores mid-flight
+  streams from disk; a per-step
+  :class:`~edgellm_tpu.serve.recovery.Watchdog` guards wedged steps with the
+  same typed :class:`~edgellm_tpu.serve.recovery.DecodeTimeout` the serving
+  front already handles.
+
+``ServeFront`` integration lives in ``serve/frontend.py`` (``batcher=``):
+admission control, brownout and breakers all apply before a request reaches
+the batcher — this module is only the inner scheduler.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..models.configs import ModelConfig
+from ..models.paged_kv import OutOfPages, OutOfSlots, PagedKVCache, \
+    paged_decode_step
+from .decode import _prefill_jit, _sample
+from .recovery import CheckpointError, DecodeCheckpoint, Watchdog
+
+
+def _model_sig(cfg: ModelConfig) -> dict:
+    """The same model signature ``recovery.runtime_plan_meta`` records, so a
+    paged stream checkpoint refuses restore onto a different model."""
+    return {"family": cfg.family, "num_layers": cfg.num_layers,
+            "hidden_size": cfg.hidden_size, "num_heads": cfg.num_heads,
+            "vocab_size": cfg.vocab_size}
+
+
+@dataclass(frozen=True)
+class BatchingConfig:
+    """Pool geometry + scheduler knobs. One compiled step per geometry."""
+
+    page_size: int = 16
+    num_pages: int = 65          # includes the reserved trash page 0
+    max_slots: int = 4
+    pages_per_slot: int = 8
+    compute_dtype: Any = None
+    cache_dtype: Any = jnp.float32
+    checkpoint_dir: Optional[str] = None
+    step_deadline_s: Optional[float] = None
+
+    def __post_init__(self):
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.num_pages < 2:
+            raise ValueError(
+                f"num_pages must be >= 2 (page 0 is reserved), got "
+                f"{self.num_pages}")
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {self.max_slots}")
+        if self.pages_per_slot < 1:
+            raise ValueError(
+                f"pages_per_slot must be >= 1, got {self.pages_per_slot}")
+        if self.step_deadline_s is not None and self.step_deadline_s <= 0:
+            raise ValueError("step_deadline_s must be positive")
+
+    @property
+    def span(self) -> int:
+        return self.pages_per_slot * self.page_size
+
+
+@dataclass
+class Stream:
+    """One request's host-side state across admit/evict/finish."""
+
+    sid: int
+    prompt: np.ndarray            # (S,) int32
+    max_new_tokens: int
+    temperature: float
+    rng_seed: int
+    status: str = "waiting"       # waiting | running | finished
+    slot: int = -1
+    tokens: list = field(default_factory=list)  # sampled ids, host ints
+    resume: Optional[dict] = None  # gathered {"k","v","length"} for re-admit
+    admit_seq: int = -1           # admission order; youngest = largest
+    evictions: int = 0
+
+    @property
+    def t(self) -> int:
+        """Next decode-step index == tokens sampled so far (token 0 comes
+        from the prefill, exactly as in ``generate``)."""
+        return len(self.tokens)
+
+    @property
+    def key(self) -> jax.Array:
+        return jax.random.key(self.rng_seed)
+
+
+def _batched_sample(logits, keys, steps, temps):
+    """Per-slot ``decode._sample``, vectorized bit-identically: slot i's
+    token equals ``_sample(logits[i:i+1], fold_in(key_i, step_i), temp_i)``
+    — fold_in/categorical vmap to the same draws as their single-row calls,
+    argmax rows are batch-invariant, and the where just selects which lane
+    slot i uses (temperature stays a TRACED per-slot value, so greedy and
+    sampled streams share one executable)."""
+    folded = jax.vmap(jax.random.fold_in)(keys, steps)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe = jnp.where(temps > 0.0, temps, 1.0)
+    cat = jax.vmap(jax.random.categorical)(
+        folded, logits / safe[:, None]).astype(jnp.int32)
+    return jnp.where(temps > 0.0, cat, greedy)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "compute_dtype"),
+                   donate_argnums=(2, 3))
+def _batched_step_jit(cfg: ModelConfig, params: dict, pool_k, pool_v,
+                      page_table, lengths, token_ids, keys, steps, temps,
+                      compute_dtype):
+    logits, pool_k, pool_v = paged_decode_step(
+        cfg, params, pool_k, pool_v, page_table, lengths, token_ids,
+        compute_dtype=compute_dtype)
+    return _batched_sample(logits, keys, steps, temps), pool_k, pool_v
+
+
+def batched_step_cache_size() -> int:
+    """Executables compiled for the ragged step so far in this process — the
+    jit-miss counter :meth:`ContinuousBatcher.step` reports deltas of."""
+    return _batched_step_jit._cache_size()
+
+
+class ContinuousBatcher:
+    """Admit/evict streams mid-flight into one compiled ragged decode step.
+
+    Lifecycle: :meth:`submit` queues a stream; :meth:`step` admits waiting
+    streams into free slots (prefill + page adoption), runs ONE jitted step
+    for every running slot, appends each slot's sampled token, retires
+    finished streams, and — when the pool cannot cover a growth — evicts the
+    youngest running stream back to the waiting queue with its gathered KV
+    prefix. :meth:`run` loops :meth:`step` to completion. ``results[sid]``
+    holds each finished stream's (max_new_tokens,) int32 tokens.
+    """
+
+    def __init__(self, cfg: ModelConfig, params: dict,
+                 bcfg: Optional[BatchingConfig] = None):
+        self.cfg = cfg
+        self.params = params
+        self.bcfg = bcfg if bcfg is not None else BatchingConfig()
+        self.pool = PagedKVCache(
+            cfg, num_pages=self.bcfg.num_pages,
+            page_size=self.bcfg.page_size, max_slots=self.bcfg.max_slots,
+            pages_per_slot=self.bcfg.pages_per_slot,
+            dtype=self.bcfg.cache_dtype)
+        self._streams: dict[int, Stream] = {}
+        self._waiting: deque[int] = deque()
+        self._slot_to_sid: dict[int, int] = {}
+        self._next_sid = 0
+        self._admit_seq = 0
+        self.results: dict[int, np.ndarray] = {}
+        self._watchdog = (Watchdog(self.bcfg.step_deadline_s)
+                          if self.bcfg.step_deadline_s is not None else None)
+        self.stats = {"steps": 0, "admitted": 0, "evicted": 0, "finished": 0,
+                      "jit_misses": 0, "prefill_s": 0.0, "decode_s": 0.0,
+                      "occupancy_samples": [], "slot_samples": [],
+                      "alloc_samples": []}
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt_ids, max_new_tokens: int, *,
+               temperature: float = 0.0, rng_seed: int = 0) -> int:
+        """Queue a stream; same argument semantics as ``generate`` with
+        ``rng_key = jax.random.key(rng_seed)``. Returns the stream id."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if float(temperature) < 0.0:
+            raise ValueError("temperature must be >= 0")
+        need = prompt.size + max_new_tokens - 1  # final token is not written
+        if need > self.bcfg.span:
+            raise ValueError(
+                f"prompt {prompt.size} + {max_new_tokens} new tokens needs "
+                f"{need} cache positions > slot span {self.bcfg.span} "
+                f"(pages_per_slot={self.bcfg.pages_per_slot} x "
+                f"page_size={self.bcfg.page_size})")
+        sid = self._next_sid
+        self._next_sid += 1
+        self._streams[sid] = Stream(sid, prompt, int(max_new_tokens),
+                                    float(temperature), int(rng_seed))
+        self._waiting.append(sid)
+        return sid
+
+    # -- admission / eviction ----------------------------------------------
+
+    def _cache_len(self, st: Stream) -> int:
+        """Positions st's cache holds at the top of step t: the prompt plus
+        the t-1 tokens already fed back (token t-1 is pending feed)."""
+        return st.prompt.size + max(st.t - 1, 0)
+
+    def _try_admit(self, sid: int) -> bool:
+        st = self._streams[sid]
+        need_len = (int(st.resume["length"]) if st.resume is not None
+                    else st.prompt.size)
+        if self.pool.pages_for(need_len + 1) > self.pool.num_free_pages:
+            return False  # +1: the admitting step itself must be coverable
+        try:
+            slot = self.pool.alloc_slot()
+        except OutOfSlots:
+            return False
+        t0 = time.monotonic()
+        if st.resume is not None:
+            self.pool.adopt(slot, jnp.asarray(st.resume["k"]),
+                            jnp.asarray(st.resume["v"]), need_len)
+            st.resume = None
+        else:
+            # the exact generate() prefill: same executable, same capacity
+            # semantics (KV values are capacity-invariant), same token-0 key
+            last_logits, cache = _prefill_jit(
+                self.cfg, self.params, jnp.asarray(st.prompt[None, :]),
+                self.bcfg.span, self.bcfg.compute_dtype)
+            tok0 = _sample(last_logits, jax.random.fold_in(st.key, 0),
+                           st.temperature)
+            s = st.prompt.size
+            self.pool.adopt(slot, cache.k[:, 0, :s], cache.v[:, 0, :s], s)
+            st.tokens.append(int(np.asarray(tok0)[0]))
+        self.stats["prefill_s"] += time.monotonic() - t0
+        st.status, st.slot = "running", slot
+        st.admit_seq = self._admit_seq
+        self._admit_seq += 1
+        self._slot_to_sid[slot] = sid
+        self.stats["admitted"] += 1
+        if st.t >= st.max_new_tokens:  # max_new_tokens == 1: prefill is all
+            self._finish(st)
+        return True
+
+    def evict(self, sid: int) -> None:
+        """Push a running stream back to the waiting queue, gathering its
+        pages to a contiguous prefix (byte-identical to a contiguous cache,
+        so re-admission — here or after a disk round-trip — resumes
+        token-identically)."""
+        st = self._streams[sid]
+        if st.status != "running":
+            raise ValueError(f"stream {sid} is not running")
+        st.resume = self.pool.gather_slot(st.slot)
+        self.pool.free_slot(st.slot)
+        del self._slot_to_sid[st.slot]
+        st.status, st.slot = "waiting", -1
+        st.evictions += 1
+        self._waiting.appendleft(sid)  # resumed work goes to the head
+        self.stats["evicted"] += 1
+        if self.bcfg.checkpoint_dir is not None:
+            self.checkpoint_stream(
+                sid, os.path.join(self.bcfg.checkpoint_dir,
+                                  f"stream_{sid}.ckpt"))
+
+    def _evict_for_pages(self, needed: int, protect: set) -> bool:
+        """Evict youngest-admitted running streams (never ``protect``) until
+        ``needed`` pages are free. Youngest-first keeps old streams' work."""
+        while self.pool.num_free_pages < needed:
+            victims = [st for st in self._streams.values()
+                       if st.status == "running" and st.sid not in protect]
+            if not victims:
+                return False
+            self.evict(max(victims, key=lambda s: s.admit_seq).sid)
+        return True
+
+    def _finish(self, st: Stream) -> None:
+        self.results[st.sid] = np.asarray(st.tokens, np.int32)
+        self.pool.free_slot(st.slot)
+        del self._slot_to_sid[st.slot]
+        st.status, st.slot = "finished", -1
+        self.stats["finished"] += 1
+
+    # -- the ragged step ---------------------------------------------------
+
+    def _running(self) -> list[Stream]:
+        return [self._streams[sid] for sid in self._slot_to_sid.values()]
+
+    def step(self) -> int:
+        """Admit what fits, run ONE compiled ragged step over every running
+        slot, commit the sampled tokens. Returns the number of streams that
+        advanced (0 = nothing running and nothing admittable)."""
+        # admit in FIFO order until a stream doesn't fit (no overtaking:
+        # admission order stays deterministic)
+        while self._waiting:
+            sid = self._waiting[0]
+            if not self._try_admit(sid):
+                break
+            self._waiting.popleft()
+        running = self._running()
+        if not running:
+            return 0
+        # every running slot must be able to take this step's token; evict
+        # youngest streams when the pool can't cover a growth (oldest first
+        # keeps them protected longest)
+        for st in sorted(running, key=lambda s: s.admit_seq):
+            if st.status != "running":
+                continue  # already evicted by a predecessor's growth
+            try:
+                self.pool.ensure(st.slot, self._cache_len(st) + 1)
+            except OutOfPages:
+                need = self.pool.pages_for(self._cache_len(st) + 1) \
+                    - len(self.pool._slot_pages[st.slot])
+                if not self._evict_for_pages(need, {st.sid}):
+                    raise
+                self.pool.ensure(st.slot, self._cache_len(st) + 1)
+        running = self._running()
+        if not running:
+            return 0
+
+        if self._watchdog is not None:
+            self._watchdog.arm()
+        b = self.bcfg.max_slots
+        token_ids = np.zeros((b,), np.int32)
+        steps = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        keys = [jax.random.key(0)] * b
+        for st in running:
+            token_ids[st.slot] = st.tokens[-1]
+            steps[st.slot] = st.t
+            temps[st.slot] = st.temperature
+            keys[st.slot] = st.key
+        # the pool's lengths array is the step's write/mask positions: slot
+        # i's cache holds prompt + t-1 fed tokens (== pool lengths by
+        # construction); inactive slots write the trash page
+        page_table, lengths = self.pool.device_tables()
+        misses0 = batched_step_cache_size()
+        t0 = time.monotonic()
+        toks, k, v = _batched_step_jit(
+            self.cfg, self.params, self.pool.pool.k, self.pool.pool.v,
+            page_table, lengths, jnp.asarray(token_ids),
+            jnp.stack(keys), jnp.asarray(steps), jnp.asarray(temps),
+            self.bcfg.compute_dtype)
+        self.pool.pool = type(self.pool.pool)(k, v)
+        toks_host = np.asarray(toks)  # ONE host sync per step
+        self.stats["decode_s"] += time.monotonic() - t0
+        self.stats["jit_misses"] += batched_step_cache_size() - misses0
+        self.stats["steps"] += 1
+
+        advanced = 0
+        for st in running:
+            # toks_host is already on host (the single np.asarray sync
+            # above); this int() is numpy scalar unboxing, not a device sync
+            st.tokens.append(int(toks_host[st.slot]))  # graphlint: disable=EG005
+            self.pool.lengths[st.slot] = self._cache_len(st)
+            advanced += 1
+            if st.t >= st.max_new_tokens:
+                self._finish(st)
+        self.stats["occupancy_samples"].append(
+            self.pool.live_tokens / self.pool.token_capacity)
+        self.stats["slot_samples"].append(len(self._slot_to_sid) / b)
+        # live tokens per RESERVED token — the denominator is only the pages
+        # actually allocated, the paged answer to static batching's
+        # worst-case (batch x capacity) reservation
+        reserved = (self.pool.num_pages - 1
+                    - self.pool.num_free_pages) * self.pool.page_size
+        if reserved:
+            self.stats["alloc_samples"].append(
+                self.pool.live_tokens / reserved)
+        if self._watchdog is not None:
+            self._watchdog.check()
+        return advanced
+
+    def run(self, max_steps: int = 100_000) -> dict[int, np.ndarray]:
+        """Drive :meth:`step` until every submitted stream finished."""
+        for _ in range(max_steps):
+            if not self._waiting and not self._slot_to_sid:
+                break
+            if self.step() == 0 and self._waiting:
+                raise OutOfPages(
+                    "no stream can make progress: the pool cannot hold even "
+                    "one waiting stream — shrink prompts or grow the pool")
+        return self.results
+
+    # -- checkpoint / restore ----------------------------------------------
+
+    def checkpoint_stream(self, sid: int, path: str) -> str:
+        """Snapshot one stream — running (pages gathered) or waiting with a
+        resume payload — as a :class:`DecodeCheckpoint`, restorable into ANY
+        pool geometry whose span covers it (the payload is the contiguous
+        prefix, not pages)."""
+        st = self._streams[sid]
+        if st.status == "running":
+            state = self.pool.gather_slot(st.slot)
+        elif st.resume is not None:
+            state = st.resume
+        else:
+            raise CheckpointError(
+                f"stream {sid} ({st.status}) has no cache state to snapshot")
+        arrays = {"cache/k": state["k"], "cache/v": state["v"],
+                  "cache/length": state["length"],
+                  "prompt_ids": st.prompt[None, :].astype(np.int32),
+                  "tokens": np.asarray(st.tokens, np.int32)[None, :]}
+        meta = {"mode": "paged", "model": _model_sig(self.cfg),
+                "sid": int(sid),
+                "step": int(st.t - 1), "rng_seed": int(st.rng_seed),
+                "temperature": float(st.temperature),
+                "max_new_tokens": int(st.max_new_tokens)}
+        return DecodeCheckpoint(arrays, meta).save(path)
+
+    def restore_stream(self, path: str) -> int:
+        """Re-queue a checkpointed stream; its remaining tokens come out
+        bit-identical to the uninterrupted run (per-step keys depend only on
+        the seed and the step index, the KV prefix is restored bit-exactly)."""
+        ckpt = DecodeCheckpoint.load(path)
+        meta = ckpt.meta
+        if meta.get("mode") != "paged":
+            raise CheckpointError(
+                f"{path} is a {meta.get('mode')!r} checkpoint, not a paged "
+                f"stream snapshot")
+        if meta.get("model") != _model_sig(self.cfg):
+            raise CheckpointError(
+                f"{path} was written for model {meta.get('model')!r}, this "
+                f"batcher runs {_model_sig(self.cfg)!r}")
+        sid = self.submit(ckpt.arrays["prompt_ids"][0],
+                          int(meta["max_new_tokens"]),
+                          temperature=float(meta["temperature"]),
+                          rng_seed=int(meta["rng_seed"]))
+        st = self._streams[sid]
+        st.tokens = [int(x) for x in ckpt.arrays["tokens"][0]]
+        st.resume = {"k": ckpt.arrays["cache/k"], "v": ckpt.arrays["cache/v"],
+                     "length": int(ckpt.arrays["cache/length"])}
+        return sid
+
+    # -- reporting ---------------------------------------------------------
+
+    def report(self) -> dict:
+        occ = self.stats["occupancy_samples"]
+        slots = self.stats["slot_samples"]
+        alloc = self.stats["alloc_samples"]
+        dec = self.stats["decode_s"]
+        emitted = sum(len(t) for t in self.results.values())
+        return {
+            "streams": len(self._streams),
+            "finished": self.stats["finished"],
+            "steps": self.stats["steps"],
+            "admitted": self.stats["admitted"],
+            "evicted": self.stats["evicted"],
+            "jit_misses": self.stats["jit_misses"],
+            "prefill_s": self.stats["prefill_s"],
+            "decode_s": dec,
+            "decode_tokens_per_s": (emitted / dec) if dec > 0 else 0.0,
+            "occupancy_mean": float(np.mean(occ)) if occ else 0.0,
+            "occupancy_max": float(np.max(occ)) if occ else 0.0,
+            "slot_util_mean": float(np.mean(slots)) if slots else 0.0,
+            "alloc_util_mean": float(np.mean(alloc)) if alloc else 0.0,
+            "span": self.bcfg.span,
+            "token_capacity": self.pool.token_capacity,
+        }

@@ -103,7 +103,8 @@ class RequestRecord:
     request_id: int
     outcome: str
     reason: str
-    backend: Optional[str]          # "split" | "local" | None (never ran)
+    backend: Optional[str]          # "split" | "local" | "batched"
+                                    # | None (never ran)
     priority: int
     submitted_at: float
     started_at: Optional[float]
@@ -224,10 +225,12 @@ class ServeFront:
                  config: Optional[ServeFrontConfig] = None,
                  link_health: Any = None,
                  compute_dtype: Any = None,
+                 batcher: Any = None,
                  clock: Clock = MONOTONIC):
         if split_runtime is not None and split_ladder is not None:
             raise ServeFrontConfigError(
                 "pass split_runtime OR split_ladder, not both")
+        self.batcher = batcher   # ContinuousBatcher, for drain_batched()
         self.model_cfg = model_cfg
         self.config = config if config is not None else ServeFrontConfig()
         self.clock = clock
@@ -372,6 +375,91 @@ class ServeFront:
             self.brownout.observe(len(self._queue)
                                   / self.admission.cfg.max_queue_depth)
             out.append(self._execute(pend))
+        return out
+
+    def drain_batched(self, max_requests: Optional[int] = None,
+                      max_steps: int = 100_000) -> list:
+        """Execute queued requests through the continuous batcher — one
+        compiled ragged decode step serving every admitted stream — instead
+        of one generate call each. Admission, brownout, deadline expiry, and
+        the local breaker apply exactly as in :meth:`drain`; each stream's
+        tokens are bit-identical to its solo ``generate`` run (the batcher's
+        core invariant, asserted by ``tests/test_batching.py``). Requests
+        with batch > 1 prompts fall back to the one-shot path — the batcher
+        serves single streams."""
+        if self.batcher is None:
+            raise ServeFrontConfigError(
+                "drain_batched needs a continuous batcher: "
+                "ServeFront(..., batcher=ContinuousBatcher(...))")
+        out: list = []
+        inflight: dict = {}   # sid -> (pend, queue_wait_s, started_at)
+        while self._queue and (max_requests is None
+                               or len(out) + len(inflight) < max_requests):
+            _, _, _, pend = heapq.heappop(self._queue)
+            self._backlog_s = max(0.0, self._backlog_s - pend.est_s)
+            self.brownout.observe(len(self._queue)
+                                  / self.admission.cfg.max_queue_depth)
+            now = self.clock()
+            wait = now - pend.submitted_at
+            b, s = pend.prompt.shape
+            d = pend.req.deadline_s
+            if d is not None and wait >= d:
+                out.append(self._finish(pend.rid, pend.req, b, s, TIMED_OUT,
+                                        "expired_in_queue", pend.submitted_at,
+                                        queue_wait_s=wait))
+                continue
+            if b != 1:
+                out.append(self._execute(pend))
+                continue
+            if not self._breakers["local"].allow():
+                out.append(self._finish(pend.rid, pend.req, b, s, REJECTED,
+                                        "circuit_open", pend.submitted_at,
+                                        queue_wait_s=wait))
+                continue
+            sid = self.batcher.submit(np.asarray(pend.prompt[0]),
+                                      pend.granted,
+                                      temperature=pend.req.temperature,
+                                      rng_seed=pend.req.rng_seed)
+            inflight[sid] = (pend, wait, now)
+        if not inflight:
+            return out
+        t0 = self.clock()
+        try:
+            results = self.batcher.run(max_steps)
+            failure = None
+        except Exception as e:  # noqa: BLE001 — a wedged pool / watchdog
+            results = self.batcher.results
+            failure = e
+        wall = self.clock() - t0
+        rep = self.batcher.report()
+        plan = {"mode": "batched",
+                "page_size": self.batcher.bcfg.page_size,
+                "num_pages": self.batcher.bcfg.num_pages,
+                "max_slots": self.batcher.bcfg.max_slots}
+        for sid in sorted(inflight):
+            pend, wait, started = inflight[sid]
+            b, s = pend.prompt.shape
+            toks = results.get(sid)
+            if toks is None:
+                self._breakers["local"].record_failure()
+                reason = (f"batcher:{type(failure).__name__}"
+                          if failure is not None else "batcher:incomplete")
+                out.append(self._finish(
+                    pend.rid, pend.req, b, s, FAILED, reason,
+                    pend.submitted_at, queue_wait_s=wait, backend="batched",
+                    started_at=started))
+                continue
+            self._breakers["local"].record_success()
+            # service/latency are whole-batch wall time: streams share the
+            # step loop, so per-request attribution would be fiction
+            out.append(self._finish(
+                pend.rid, pend.req, b, s, COMPLETED, "", pend.submitted_at,
+                queue_wait_s=wait, backend="batched", started_at=started,
+                service_s=wall, latency_s=wait + wall,
+                granted_tokens=pend.granted,
+                capacity=self.batcher.bcfg.span, plan=plan,
+                jit_misses=rep.get("jit_misses"),
+                tokens=np.asarray(toks)[None, :]))
         return out
 
     def _execute(self, p: _Pending) -> RequestRecord:

@@ -1,0 +1,457 @@
+"""Continuous batching + paged KV: allocator invariants, ragged parity,
+mid-flight admit/evict, jit-miss-free steady state, checkpoint/restore.
+
+The load-bearing claim everywhere: a stream's tokens through the paged
+ragged step are BIT-IDENTICAL to running it alone through ``generate``
+(per-step sampling keys depend only on (seed, step index); masked padding
+contributes exactly 0 to softmax; pages store the same post-rotary values
+the contiguous cache stores).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.models import init_params, tiny_config
+from edgellm_tpu.models.flash_attention import (decode_attention, decode_plan,
+                                                paged_decode_attention)
+from edgellm_tpu.models.paged_kv import (OutOfPages, OutOfSlots,
+                                         PagedKVCache)
+from edgellm_tpu.serve.batching import (BatchingConfig, ContinuousBatcher,
+                                        _batched_sample,
+                                        batched_step_cache_size)
+from edgellm_tpu.serve.decode import _sample, generate
+from edgellm_tpu.serve.recovery import CheckpointError
+
+CFG = tiny_config("qwen2", num_layers=4, hidden_size=32, num_heads=4,
+                  vocab_size=128)
+
+# one shared geometry so every batcher test reuses the same compiled ragged
+# step: span 32 = 4 pages x 8, the capacity generate() parity calls use too
+BCFG = BatchingConfig(page_size=8, num_pages=17, max_slots=4,
+                      pages_per_slot=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(1))
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, size=n).astype(np.int32)
+
+
+def _solo(params, prompt, max_new, temp=0.0, seed=0):
+    out = generate(CFG, params, jnp.asarray(prompt)[None], max_new,
+                   capacity=BCFG.span, temperature=temp,
+                   rng_key=jax.random.key(seed))
+    return np.asarray(out)[0]
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+
+def _bookkeeping(num_pages=9, page_size=4, max_slots=3, pages_per_slot=3):
+    return PagedKVCache(CFG, num_pages=num_pages, page_size=page_size,
+                        max_slots=max_slots, pages_per_slot=pages_per_slot,
+                        materialize=False)
+
+
+def test_pool_alloc_free_invariants():
+    pool = _bookkeeping()
+    s0 = pool.alloc_slot()
+    pool.ensure(s0, 5)            # 2 pages
+    pool.check_invariants()
+    assert len(pool._slot_pages[s0]) == 2
+    assert pool.num_free_pages == 8 - 2
+    s1 = pool.alloc_slot()
+    pool.ensure(s1, 12)           # 3 pages (the slot max)
+    pool.check_invariants()
+    pool.free_slot(s0)
+    pool.check_invariants()
+    assert pool.num_free_pages == 8 - 3
+    # ensure() must allocate nothing when it cannot cover the growth
+    s2 = pool.alloc_slot()
+    pool.ensure(s2, 12)
+    s3 = pool.alloc_slot()
+    free_before = pool.num_free_pages
+    with pytest.raises(OutOfPages):
+        pool.ensure(s3, 12)       # needs 3, only 2 free
+    assert pool.num_free_pages == free_before
+    pool.check_invariants()
+    with pytest.raises(OutOfSlots):
+        pool.alloc_slot()
+    with pytest.raises(ValueError):
+        pool.ensure(s3, pool.span + 1)
+
+
+def test_trash_page_never_allocated():
+    pool = _bookkeeping()
+    slots = [pool.alloc_slot() for _ in range(3)]
+    for s in slots:
+        pool.ensure(s, 8)
+        assert 0 not in pool._slot_pages[s]
+    pool.check_invariants()
+
+
+def test_bookkeeping_only_mode_guards():
+    pool = _bookkeeping()
+    assert pool.pool is None
+    for call in (lambda: pool.adopt(0, None, None, 1),
+                 lambda: pool.gather_slot(0),
+                 pool.defrag, pool.state_dict,
+                 lambda: pool.load_state_dict({})):
+        with pytest.raises(ValueError, match="materialize=False"):
+            call()
+
+
+def test_adopt_gather_roundtrip():
+    pool = PagedKVCache(CFG, num_pages=9, page_size=4, max_slots=2,
+                        pages_per_slot=3)
+    rng = np.random.default_rng(3)
+    n = 10
+    k = rng.standard_normal(
+        (CFG.num_layers, n, CFG.num_kv_heads, CFG.head_dim)).astype(np.float32)
+    v = rng.standard_normal(k.shape).astype(np.float32)
+    slot = pool.alloc_slot()
+    pool.adopt(slot, jnp.asarray(k), jnp.asarray(v), n)
+    pool.check_invariants()
+    back = pool.gather_slot(slot)
+    assert int(back["length"]) == n
+    np.testing.assert_array_equal(back["k"], k)
+    np.testing.assert_array_equal(back["v"], v)
+
+
+def test_defrag_preserves_content_and_compacts():
+    pool = PagedKVCache(CFG, num_pages=13, page_size=4, max_slots=3,
+                        pages_per_slot=4)
+    rng = np.random.default_rng(5)
+    shapes = {}
+    for n in (7, 9, 6):
+        k = rng.standard_normal((CFG.num_layers, n, CFG.num_kv_heads,
+                                 CFG.head_dim)).astype(np.float32)
+        v = rng.standard_normal(k.shape).astype(np.float32)
+        slot = pool.alloc_slot()
+        pool.adopt(slot, jnp.asarray(k), jnp.asarray(v), n)
+        shapes[slot] = (k, v)
+    pool.free_slot(1)             # hole in the middle of the pool
+    del shapes[1]
+    moved = pool.defrag()
+    pool.check_invariants()
+    assert moved > 0
+    # allocated pages are now the low contiguous range, trash page fixed
+    owned = sorted(p for pages in pool._slot_pages for p in pages)
+    assert owned == list(range(1, len(owned) + 1))
+    for slot, (k, v) in shapes.items():
+        back = pool.gather_slot(slot)
+        np.testing.assert_array_equal(back["k"], k)
+        np.testing.assert_array_equal(back["v"], v)
+
+
+# ---------------------------------------------------------------------------
+# ragged step parity
+# ---------------------------------------------------------------------------
+
+
+def test_ragged_mixed_lengths_bit_identical_to_generate(params):
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    streams = [  # mixed prompt lengths, remaining tokens, temperatures
+        dict(prompt=_prompt(5, 1), max_new=6, temp=0.0, seed=11),
+        dict(prompt=_prompt(9, 2), max_new=4, temp=0.7, seed=22),
+        dict(prompt=_prompt(13, 3), max_new=8, temp=1.1, seed=33),
+    ]
+    sids = [bat.submit(s["prompt"], s["max_new"], temperature=s["temp"],
+                       rng_seed=s["seed"]) for s in streams]
+    results = bat.run()
+    for sid, s in zip(sids, streams):
+        np.testing.assert_array_equal(
+            results[sid], _solo(params, s["prompt"], s["max_new"],
+                                s["temp"], s["seed"]))
+    rep = bat.report()
+    assert rep["finished"] == 3 and rep["evicted"] == 0
+    assert rep["jit_misses"] <= 1  # at most the one warmup compile
+
+
+def test_steady_state_is_jit_miss_free(params):
+    # warm the geometry's executable...
+    warm = ContinuousBatcher(CFG, params, BCFG)
+    warm.submit(_prompt(4), 2)
+    warm.run()
+    # ...then a FRESH batcher with different streams never compiles again:
+    # admit/evict/fill states are traced inputs, not trace constants
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    before = batched_step_cache_size()
+    for i, (n, m) in enumerate([(3, 5), (11, 3), (7, 7), (6, 4), (9, 2)]):
+        bat.submit(_prompt(n, seed=i), m, temperature=0.5 * i, rng_seed=i)
+    bat.run()
+    assert batched_step_cache_size() == before
+    assert bat.report()["jit_misses"] == 0
+
+
+def test_eviction_under_pressure_still_bit_identical(params):
+    # pool too small for all three streams at once: the youngest evicts
+    # mid-flight, re-queues with its gathered prefix, and STILL matches solo
+    tight = BatchingConfig(page_size=8, num_pages=8, max_slots=4,
+                           pages_per_slot=4)  # 7 allocatable pages
+    bat = ContinuousBatcher(CFG, params, tight)
+    streams = [
+        dict(prompt=_prompt(15, 7), max_new=8, temp=0.0, seed=1),
+        dict(prompt=_prompt(14, 8), max_new=8, temp=0.9, seed=2),
+        dict(prompt=_prompt(13, 9), max_new=8, temp=0.0, seed=3),
+    ]
+    sids = [bat.submit(s["prompt"], s["max_new"], temperature=s["temp"],
+                       rng_seed=s["seed"]) for s in streams]
+    results = bat.run()
+    assert bat.report()["evicted"] > 0
+    for sid, s in zip(sids, streams):
+        np.testing.assert_array_equal(
+            results[sid], _solo(params, s["prompt"], s["max_new"],
+                                s["temp"], s["seed"]))
+
+
+def test_explicit_midflight_evict_resumes_identically(params):
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    p = _prompt(6, 4)
+    sid = bat.submit(p, 8, temperature=0.8, rng_seed=9)
+    for _ in range(3):
+        bat.step()
+    bat.evict(sid)
+    assert bat._streams[sid].status == "waiting"
+    results = bat.run()
+    np.testing.assert_array_equal(results[sid],
+                                  _solo(params, p, 8, 0.8, 9))
+    assert bat._streams[sid].evictions == 1
+
+
+def test_max_new_tokens_one_is_prefill_only(params):
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    p = _prompt(5, 6)
+    sid = bat.submit(p, 1)
+    results = bat.run()
+    np.testing.assert_array_equal(results[sid], _solo(params, p, 1))
+
+
+def test_run_raises_when_no_stream_can_fit(params):
+    # span covers the request, but the pool never has enough free pages
+    wedged = BatchingConfig(page_size=8, num_pages=3, max_slots=2,
+                            pages_per_slot=4)  # 2 allocatable pages
+    bat = ContinuousBatcher(CFG, params, wedged)
+    bat.submit(_prompt(20), 4)    # needs 3 pages just to admit
+    with pytest.raises(OutOfPages):
+        bat.run()
+
+
+def test_submit_validation(params):
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    with pytest.raises(ValueError):
+        bat.submit(np.zeros((0,), np.int32), 4)
+    with pytest.raises(ValueError):
+        bat.submit(_prompt(4), 0)
+    with pytest.raises(ValueError):
+        bat.submit(_prompt(4), 4, temperature=-0.1)
+    with pytest.raises(ValueError):
+        bat.submit(_prompt(30), 8)  # 30 + 8 - 1 > span 32
+
+
+def test_trash_page_stays_finite(params):
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    bat.submit(_prompt(5), 6)     # slots 1-3 inactive: they write page 0
+    bat.run()
+    assert np.isfinite(np.asarray(bat.pool.pool.k[:, 0])).all()
+    assert np.isfinite(np.asarray(bat.pool.pool.v[:, 0])).all()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / restore
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_restore_across_pool_geometry(params, tmp_path):
+    p = _prompt(7, 10)
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    sid = bat.submit(p, 8, temperature=0.6, rng_seed=42)
+    for _ in range(4):
+        bat.step()
+    path = bat.checkpoint_stream(sid, str(tmp_path / "s.ckpt"))
+    # restore into a DIFFERENT pool geometry: the payload is the contiguous
+    # prefix, so any span that covers it works
+    other = ContinuousBatcher(
+        CFG, params, BatchingConfig(page_size=4, num_pages=17, max_slots=2,
+                                    pages_per_slot=8))
+    rid = other.restore_stream(path)
+    results = other.run()
+    np.testing.assert_array_equal(results[rid],
+                                  _solo(params, p, 8, 0.6, 42))
+
+
+def test_checkpoint_refuses_other_model(params, tmp_path):
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    sid = bat.submit(_prompt(5), 4)
+    bat.step()
+    path = bat.checkpoint_stream(sid, str(tmp_path / "s.ckpt"))
+    other_cfg = tiny_config("qwen2", num_layers=2, hidden_size=32,
+                            num_heads=4, vocab_size=128)
+    other = ContinuousBatcher(other_cfg, init_params(other_cfg,
+                                                     jax.random.key(0)), BCFG)
+    with pytest.raises(CheckpointError, match="model"):
+        other.restore_stream(path)
+
+
+# ---------------------------------------------------------------------------
+# kernel plan gates + attention fallback
+# ---------------------------------------------------------------------------
+
+
+def test_decode_plan_paged_gates(monkeypatch):
+    # contiguous decode has no validated kernel: always None
+    assert decode_plan(256, 4, 2, 64) is None
+    # paged + forced pallas: the plan dispatches on any backend
+    monkeypatch.setenv("EDGELLM_ATTN", "pallas")
+    assert decode_plan(64, 4, 2, 64, pages=(8, 8)) == ("paged", (8, 8))
+    assert decode_plan(64, 4, 2, 64, pages=(4, 8)) is None  # pps*ps != cap
+    assert decode_plan(64, 4, 2, 64, pages=(16, 4)) is None  # ps % 8
+    assert decode_plan(64, 4, 2, 8, pages=(8, 8)) is None   # hd unvalidated
+    monkeypatch.setenv("EDGELLM_ATTN", "xla")
+    assert decode_plan(64, 4, 2, 64, pages=(8, 8)) is None
+    monkeypatch.delenv("EDGELLM_ATTN")
+    if jax.default_backend() != "tpu":
+        # default: off-TPU the paged kernel is never earned
+        assert decode_plan(64, 4, 2, 64, pages=(8, 8)) is None
+
+
+def test_paged_attention_fallback_matches_contiguous():
+    # the XLA gather fallback must agree bitwise with decode_attention over
+    # each slot's contiguous view, and be invariant to garbage beyond length
+    rng = np.random.default_rng(11)
+    b, h, kv, hd, pn, ps, pps = 3, 4, 2, 8, 7, 4, 2
+    span = pps * ps
+    q = jnp.asarray(rng.standard_normal((b, 1, h, hd)).astype(np.float32))
+    kp = jnp.asarray(rng.standard_normal((pn, ps, kv, hd)).astype(np.float32))
+    vp = jnp.asarray(rng.standard_normal(kp.shape).astype(np.float32))
+    pt = jnp.asarray([[1, 2], [3, 4], [5, 6]], jnp.int32)
+    lengths = jnp.asarray([3, 8, 5], jnp.int32)
+    out = paged_decode_attention(q, kp, vp, pt, lengths)
+    idx = (np.asarray(pt)[:, :, None] * ps
+           + np.arange(ps)[None, None, :]).reshape(b, span)
+    kg = jnp.asarray(np.asarray(kp).reshape(pn * ps, kv, hd)[idx])
+    vg = jnp.asarray(np.asarray(vp).reshape(pn * ps, kv, hd)[idx])
+    ref = decode_attention(q, kg, vg, lengths)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # scribble over every position past each slot's length: masked entries
+    # contribute exactly 0, so the output must not change by a single bit
+    kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+    for i in range(b):
+        for pos in range(int(lengths[i]), span):
+            page, off = np.asarray(pt)[i, pos // ps], pos % ps
+            kp2[page, off] = 1e6 * (i + 1)
+            vp2[page, off] = -1e6
+    out2 = paged_decode_attention(q, jnp.asarray(kp2), jnp.asarray(vp2),
+                                  pt, lengths)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_batched_sample_matches_single_row():
+    rng = np.random.default_rng(13)
+    logits = jnp.asarray(rng.standard_normal((4, 128)).astype(np.float32))
+    keys = jnp.stack([jax.random.key(s) for s in (7, 8, 9, 10)])
+    steps = jnp.asarray([0, 3, 5, 2], jnp.int32)
+    temps = jnp.asarray([0.0, 0.7, 1.3, 0.0], jnp.float32)
+    got = np.asarray(_batched_sample(logits, keys, steps, temps))
+    for i in range(4):
+        want = _sample(logits[i:i + 1],
+                       jax.random.fold_in(keys[i], steps[i]),
+                       float(temps[i]))
+        assert got[i] == int(np.asarray(want)[0])
+
+
+# ---------------------------------------------------------------------------
+# ServeFront integration
+# ---------------------------------------------------------------------------
+
+
+def test_drain_batched_front_matches_generate(params):
+    from edgellm_tpu.serve import Request, ServeFront
+
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    front = ServeFront(CFG, params, batcher=bat)
+    reqs = [(_prompt(5, 20), 4, 0.0, 1), (_prompt(9, 21), 6, 0.8, 2),
+            (_prompt(12, 22), 5, 0.0, 3)]
+    for p, m, t, s in reqs:
+        front.submit(Request(prompt_ids=p, max_new_tokens=m, temperature=t,
+                             rng_seed=s))
+    recs = front.drain_batched()
+    assert len(recs) == 3
+    by_prompt = {r.prompt_tokens: r for r in recs}
+    for p, m, t, s in reqs:
+        rec = by_prompt[len(p)]
+        assert rec.outcome == "completed" and rec.backend == "batched"
+        np.testing.assert_array_equal(rec.tokens[0],
+                                      _solo(params, p, m, t, s))
+
+
+# ---------------------------------------------------------------------------
+# split runtime: per-stage pools page the same way
+# ---------------------------------------------------------------------------
+
+
+def test_split_paged_decode_matches_generate_split(params):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from edgellm_tpu.parallel import SplitConfig, SplitRuntime, make_stage_mesh
+    from edgellm_tpu.serve.decode import generate_split
+
+    mesh = make_stage_mesh(2)
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(2,),
+                                       hop_codecs=("int8_per_token",)), mesh)
+    placed = rt.place_params(params)
+    streams = [dict(prompt=_prompt(5, 30), max_new=5, temp=0.0, seed=11),
+               dict(prompt=_prompt(9, 31), max_new=4, temp=0.7, seed=22)]
+    ref = [np.asarray(generate_split(
+        rt, placed, jnp.asarray(s["prompt"])[None], s["max_new"],
+        capacity=32, temperature=s["temp"],
+        rng_key=jax.random.key(s["seed"])))[0] for s in streams]
+
+    ps, npg, ms, pps = 8, 9, 4, 4
+    host = PagedKVCache(CFG, num_pages=npg, page_size=ps, max_slots=ms,
+                        pages_per_slot=pps, materialize=False)
+    pool = rt.init_paged_pool(npg, ps)
+    state = {}
+    for i, s in enumerate(streams):
+        n = len(s["prompt"])
+        logits, cache = rt.prefill_decode(placed,
+                                          jnp.asarray(s["prompt"])[None], 32)
+        key = jax.random.key(s["seed"])
+        tok0 = int(_sample(logits[:, -1], jax.random.fold_in(key, 0),
+                           s["temp"])[0])
+        slot = host.alloc_slot()
+        host.ensure(slot, n)
+        pool = rt.adopt_paged(pool, cache, 0, host._flat_indices(slot, n), n)
+        host.lengths[slot] = n
+        host.check_invariants()
+        state[slot] = dict(i=i, key=key, toks=[tok0], **s)
+    while any(len(v["toks"]) < v["max_new"] for v in state.values()):
+        tok_ids = np.zeros((ms,), np.int32)
+        active = []
+        for slot, v in state.items():
+            if len(v["toks"]) >= v["max_new"]:
+                continue
+            host.ensure(slot, int(host.lengths[slot]) + 1)
+            tok_ids[slot] = v["toks"][-1]
+            active.append(slot)
+        pt, lens = host.device_tables()
+        logits, pool = rt.decode_step_paged(placed, pool, pt, lens,
+                                            jnp.asarray(tok_ids))
+        for slot in active:
+            v = state[slot]
+            tok = int(_sample(logits[slot][None],
+                              jax.random.fold_in(v["key"], len(v["toks"])),
+                              v["temp"])[0])
+            v["toks"].append(tok)
+            host.lengths[slot] = int(host.lengths[slot]) + 1
+    for v in state.values():
+        np.testing.assert_array_equal(np.asarray(v["toks"], np.int32),
+                                      ref[v["i"]])
