@@ -288,24 +288,28 @@ class PagedKVCache:
         tables are rewritten to match, so every slot's logical content is
         unchanged."""
         self._require_pool("defrag")
-        mapping = np.arange(self.num_pages, dtype=np.int32)  # old -> new
-        nxt = 1
-        for s in range(self.max_slots):
-            for p in self._slot_pages[s]:
-                mapping[p] = nxt
-                nxt += 1
+        # src (new -> old) must be a TRUE permutation: after alloc/grow/free
+        # churn an owned page's compacted destination can be a currently-free
+        # page with a HIGHER id (e.g. slot pages [[4],[2],[1]] with page 3
+        # free), so inverting an old->new map would collide with the free
+        # page's identity entry and gather garbage into the destination.
+        # Place owned pages at their destinations first, then spread the
+        # leftover old pages over the remaining destinations.
+        src = np.zeros((self.num_pages,), np.int32)  # new -> old; src[0] = 0
         moved = 0
-        src = np.zeros((self.num_pages,), np.int32)  # new -> old
-        for old in range(self.num_pages):
-            src[mapping[old]] = old
+        nxt = 1
         for s in range(self.max_slots):
             pages = self._slot_pages[s]
             for j, p in enumerate(pages):
-                if mapping[p] != p:
+                src[nxt] = p
+                if p != nxt:
                     moved += 1
-                pages[j] = int(mapping[p])
-                self.page_table[s, j] = pages[j]
-                self._owner[pages[j]] = s
+                pages[j] = nxt
+                self.page_table[s, j] = nxt
+                self._owner[nxt] = s
+                nxt += 1
+        placed = set(int(x) for x in src[:nxt])
+        src[nxt:] = [p for p in range(1, self.num_pages) if p not in placed]
         for p in range(nxt, self.num_pages):
             self._owner[p] = FREE
         self._free = list(range(self.num_pages - 1, nxt - 1, -1))

@@ -189,10 +189,13 @@ class ContinuousBatcher:
         self.results: dict[int, np.ndarray] = {}
         self._watchdog = (Watchdog(self.bcfg.step_deadline_s)
                           if self.bcfg.step_deadline_s is not None else None)
-        self.stats = {"steps": 0, "admitted": 0, "evicted": 0, "finished": 0,
-                      "jit_misses": 0, "prefill_s": 0.0, "decode_s": 0.0,
-                      "occupancy_samples": [], "slot_samples": [],
-                      "alloc_samples": []}
+        # running aggregates only — a long-lived server takes millions of
+        # steps, so no per-step sample lists
+        self.stats = {"steps": 0, "submitted": 0, "admitted": 0, "evicted": 0,
+                      "finished": 0, "jit_misses": 0, "emitted_tokens": 0,
+                      "prefill_s": 0.0, "decode_s": 0.0,
+                      "occ_sum": 0.0, "occ_max": 0.0, "slot_sum": 0.0,
+                      "alloc_sum": 0.0, "alloc_n": 0}
 
     # -- submission --------------------------------------------------------
 
@@ -219,7 +222,35 @@ class ContinuousBatcher:
         self._streams[sid] = Stream(sid, prompt, int(max_new_tokens),
                                     float(temperature), int(rng_seed))
         self._waiting.append(sid)
+        self.stats["submitted"] += 1
         return sid
+
+    def pop_result(self, sid: int) -> np.ndarray:
+        """Return and forget a finished stream's tokens. Long-lived callers
+        (``ServeFront.drain_batched``) consume results through this so
+        finished streams don't accumulate in ``results``/``_streams``."""
+        toks = self.results.pop(sid)
+        self._streams.pop(sid, None)
+        return toks
+
+    def discard(self, sid: int) -> None:
+        """Drop a stream in any state and forget its result — the orphan
+        hatch: an aborted drain would otherwise leave its inflight streams
+        queued forever with no caller to collect them, rerunning on the next
+        drain. Frees a running stream's slot and pages."""
+        st = self._streams.pop(sid, None)
+        self.results.pop(sid, None)
+        if st is None:
+            return
+        if st.status == "running":
+            self.pool.free_slot(st.slot)
+            del self._slot_to_sid[st.slot]
+        elif st.status == "waiting":
+            try:
+                self._waiting.remove(sid)
+            except ValueError:
+                pass
+        st.status = "discarded"
 
     # -- admission / eviction ----------------------------------------------
 
@@ -301,6 +332,7 @@ class ContinuousBatcher:
         del self._slot_to_sid[st.slot]
         st.status, st.slot = "finished", -1
         self.stats["finished"] += 1
+        self.stats["emitted_tokens"] += len(st.tokens)
 
     # -- the ragged step ---------------------------------------------------
 
@@ -377,17 +409,18 @@ class ContinuousBatcher:
             advanced += 1
             if st.t >= st.max_new_tokens:
                 self._finish(st)
-        self.stats["occupancy_samples"].append(
-            self.pool.live_tokens / self.pool.token_capacity)
-        self.stats["slot_samples"].append(len(self._slot_to_sid) / b)
+        occ = self.pool.live_tokens / self.pool.token_capacity
+        self.stats["occ_sum"] += occ
+        self.stats["occ_max"] = max(self.stats["occ_max"], occ)
+        self.stats["slot_sum"] += len(self._slot_to_sid) / b
         # live tokens per RESERVED token — the denominator is only the pages
         # actually allocated, the paged answer to static batching's
         # worst-case (batch x capacity) reservation
         reserved = (self.pool.num_pages - 1
                     - self.pool.num_free_pages) * self.pool.page_size
         if reserved:
-            self.stats["alloc_samples"].append(
-                self.pool.live_tokens / reserved)
+            self.stats["alloc_sum"] += self.pool.live_tokens / reserved
+            self.stats["alloc_n"] += 1
         if self._watchdog is not None:
             self._watchdog.check()
         return advanced
@@ -456,25 +489,25 @@ class ContinuousBatcher:
     # -- reporting ---------------------------------------------------------
 
     def report(self) -> dict:
-        occ = self.stats["occupancy_samples"]
-        slots = self.stats["slot_samples"]
-        alloc = self.stats["alloc_samples"]
+        n = self.stats["steps"]
+        alloc_n = self.stats["alloc_n"]
         dec = self.stats["decode_s"]
-        emitted = sum(len(t) for t in self.results.values())
+        emitted = self.stats["emitted_tokens"]
         return {
-            "streams": len(self._streams),
+            "streams": self.stats["submitted"],
             "finished": self.stats["finished"],
-            "steps": self.stats["steps"],
+            "steps": n,
             "admitted": self.stats["admitted"],
             "evicted": self.stats["evicted"],
             "jit_misses": self.stats["jit_misses"],
             "prefill_s": self.stats["prefill_s"],
             "decode_s": dec,
             "decode_tokens_per_s": (emitted / dec) if dec > 0 else 0.0,
-            "occupancy_mean": float(np.mean(occ)) if occ else 0.0,
-            "occupancy_max": float(np.max(occ)) if occ else 0.0,
-            "slot_util_mean": float(np.mean(slots)) if slots else 0.0,
-            "alloc_util_mean": float(np.mean(alloc)) if alloc else 0.0,
+            "occupancy_mean": (self.stats["occ_sum"] / n) if n else 0.0,
+            "occupancy_max": self.stats["occ_max"],
+            "slot_util_mean": (self.stats["slot_sum"] / n) if n else 0.0,
+            "alloc_util_mean": ((self.stats["alloc_sum"] / alloc_n)
+                                if alloc_n else 0.0),
             "span": self.bcfg.span,
             "token_capacity": self.pool.token_capacity,
         }

@@ -416,10 +416,21 @@ class ServeFront:
                                         "circuit_open", pend.submitted_at,
                                         queue_wait_s=wait))
                 continue
-            sid = self.batcher.submit(np.asarray(pend.prompt[0]),
-                                      pend.granted,
-                                      temperature=pend.req.temperature,
-                                      rng_seed=pend.req.rng_seed)
+            try:
+                sid = self.batcher.submit(np.asarray(pend.prompt[0]),
+                                          pend.granted,
+                                          temperature=pend.req.temperature,
+                                          rng_seed=pend.req.rng_seed)
+            except ValueError:
+                # prompt + granted tokens exceed the batcher's slot span — a
+                # per-request shape problem, not a backend failure: reject it
+                # and keep draining (nothing ties admission limits to the
+                # batcher geometry)
+                out.append(self._finish(pend.rid, pend.req, b, s, REJECTED,
+                                        "exceeds_slot_span",
+                                        pend.submitted_at,
+                                        queue_wait_s=wait))
+                continue
             inflight[sid] = (pend, wait, now)
         if not inflight:
             return out
@@ -440,6 +451,10 @@ class ServeFront:
             pend, wait, started = inflight[sid]
             b, s = pend.prompt.shape
             toks = results.get(sid)
+            # collected either way: finished results must not accumulate in
+            # the batcher, and a failed run's leftover streams must not rerun
+            # on the next drain with nobody to receive them
+            self.batcher.discard(sid)
             if toks is None:
                 self._breakers["local"].record_failure()
                 reason = (f"batcher:{type(failure).__name__}"

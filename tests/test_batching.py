@@ -152,6 +152,53 @@ def test_defrag_preserves_content_and_compacts():
         np.testing.assert_array_equal(back["v"], v)
 
 
+def test_defrag_churn_page_moves_up_past_free_page():
+    # regression: alloc/grow/free churn can leave an owned page whose
+    # compacted destination is a HIGHER id currently on the free list
+    # (here slot pages [[4], [2, 1]] with page 3 free: page 1's destination
+    # is 3). The old->new map is then not invertible, and a naive inversion
+    # gathered the free page's garbage into the destination — silently,
+    # since check_invariants() only sees bookkeeping.
+    pool = PagedKVCache(CFG, num_pages=6, page_size=4, max_slots=3,
+                        pages_per_slot=2)
+    rng = np.random.default_rng(11)
+
+    def kv(n):
+        k = rng.standard_normal((CFG.num_layers, n, CFG.num_kv_heads,
+                                 CFG.head_dim)).astype(np.float32)
+        return k, rng.standard_normal(k.shape).astype(np.float32)
+
+    def fill(slot, n):
+        k, v = kv(n)
+        pool.adopt(slot, jnp.asarray(k), jnp.asarray(v), n)
+        return k, v
+
+    s0 = pool.alloc_slot()
+    fill(s0, 4)                       # page [1]
+    s1 = pool.alloc_slot()
+    fill(s1, 4)                       # page [2]
+    s2 = pool.alloc_slot()
+    fill(s2, 4)                       # page [3]
+    pool.free_slot(s0)                # free: [5, 4, 1]
+    k1, v1 = fill(s1, 8)              # grows into page 1 -> [2, 1]
+    s0 = pool.alloc_slot()
+    k0, v0 = fill(s0, 4)              # pops page 4 -> [4]
+    pool.free_slot(s2)                # free: [5, 3]
+    assert pool._slot_pages[s0] == [4]
+    assert pool._slot_pages[s1] == [2, 1]
+    pool.check_invariants()
+
+    moved = pool.defrag()
+    pool.check_invariants()
+    assert moved > 0
+    owned = sorted(p for pages in pool._slot_pages for p in pages)
+    assert owned == list(range(1, len(owned) + 1))
+    for slot, (k, v) in ((s0, (k0, v0)), (s1, (k1, v1))):
+        back = pool.gather_slot(slot)
+        np.testing.assert_array_equal(back["k"], k)
+        np.testing.assert_array_equal(back["v"], v)
+
+
 # ---------------------------------------------------------------------------
 # ragged step parity
 # ---------------------------------------------------------------------------
@@ -391,6 +438,32 @@ def test_drain_batched_front_matches_generate(params):
         assert rec.outcome == "completed" and rec.backend == "batched"
         np.testing.assert_array_equal(rec.tokens[0],
                                       _solo(params, p, m, t, s))
+    # the drain consumed the finished streams: nothing accumulates in the
+    # batcher across drains on a long-lived server
+    assert bat.results == {} and bat._streams == {}
+
+
+def test_drain_batched_rejects_oversized_request_and_keeps_draining(params):
+    from edgellm_tpu.serve import Request, ServeFront
+
+    bat = ContinuousBatcher(CFG, params, BCFG)
+    front = ServeFront(CFG, params, batcher=bat)
+    good = (_prompt(5, 40), 4, 0.0, 7)
+    front.submit(Request(prompt_ids=good[0], max_new_tokens=good[1],
+                         temperature=good[2], rng_seed=good[3]))
+    # prompt + granted tokens exceed the batcher's slot span (32): the drain
+    # must record the rejection and keep serving the rest of the queue
+    front.submit(Request(prompt_ids=_prompt(30, 41), max_new_tokens=8))
+    recs = front.drain_batched()
+    assert len(recs) == 2
+    by_prompt = {r.prompt_tokens: r for r in recs}
+    bad = by_prompt[30]
+    assert bad.outcome == "rejected" and bad.reason == "exceeds_slot_span"
+    ok = by_prompt[5]
+    assert ok.outcome == "completed" and ok.backend == "batched"
+    np.testing.assert_array_equal(
+        ok.tokens[0], _solo(params, good[0], good[1], good[2], good[3]))
+    assert bat.results == {} and bat._streams == {}
 
 
 # ---------------------------------------------------------------------------
