@@ -43,15 +43,12 @@ import jax
 import jax.numpy as jnp
 
 from ..lint import graph_contract
-
-#: canary word sealed next to every payload; a dropped hop arrives all-zero
-#: and fails this check even when the zeroed payload's checksum is trivially 0
-CANARY = 0x5EA1C0DE
-
-#: Knuth's multiplicative-hash constant; ``(2i+1) * _CRC_MULT`` gives every
-#: byte position a distinct ODD weight mod 2**32 (odd => invertible => any
-#: single-byte change always moves the checksum)
-_CRC_MULT = 2654435761
+# the wire primitives (canary + checksum seal, byte accounting) moved to
+# wire_format.py so the fused hops share the exact byte layout; re-exported
+# here verbatim — every existing import path and traced graph is unchanged
+from .wire_format import (CANARY, _CRC_MULT, _leaf_crc,  # noqa: F401
+                          payload_checksum, seal_payload, tree_nbytes,
+                          verify_payload)
 
 #: per-hop counter names accumulated by :class:`FaultyLink` (all (n_hops,)
 #: int32, receiver-side, psum-replicated by the pipeline protocol):
@@ -132,48 +129,6 @@ class LinkPolicy:
             v = getattr(self, f)
             if isinstance(v, bool) or not isinstance(v, int) or v < lo:
                 raise ValueError(f"{f} must be an integer >= {lo}, got {v!r}")
-
-
-def tree_nbytes(tree: Any) -> int:
-    """Static byte size of a payload pytree (shapes/dtypes are trace-time
-    constants, so the byte-budget comparison is a python bool under jit)."""
-    return int(sum(int(np.prod(a.shape)) * a.dtype.itemsize
-                   for a in jax.tree_util.tree_leaves(tree)))
-
-
-def _leaf_crc(leaf, salt: int):
-    """Weighted byte sum of one leaf in uint32. Weights are odd (see
-    _CRC_MULT), so flipping any single byte always changes the sum."""
-    b = jax.lax.bitcast_convert_type(leaf, jnp.uint8).reshape(-1)
-    if b.size == 0:
-        return jnp.uint32(0)
-    i = jnp.arange(b.size, dtype=jnp.uint32) + jnp.uint32(salt & 0xFFFFFFFF)
-    w = (jnp.uint32(2) * i + jnp.uint32(1)) * jnp.uint32(_CRC_MULT)
-    return jnp.sum(b.astype(jnp.uint32) * w, dtype=jnp.uint32)
-
-
-def payload_checksum(payload: Any) -> jnp.ndarray:
-    """uint32 checksum over every byte of every leaf; the per-leaf salt keys
-    the positional weights so leaves can't trade bytes."""
-    crc = jnp.uint32(0)
-    for j, leaf in enumerate(jax.tree_util.tree_leaves(payload)):
-        crc = crc + _leaf_crc(leaf, j * 0x9E3779B1)
-    return crc
-
-
-def seal_payload(payload: Any) -> dict:
-    """Wrap a codec payload with its integrity sidecar (8 bytes: canary +
-    checksum) — the tree that actually crosses the wire under faults."""
-    return {"canary": jnp.full((1,), CANARY, jnp.uint32),
-            "crc": payload_checksum(payload)[None],
-            "p": payload}
-
-
-def verify_payload(sealed: dict) -> jnp.ndarray:
-    """Scalar bool: the arrived payload is intact (canary alive AND checksum
-    matches a fresh computation over the arrived bytes)."""
-    return jnp.logical_and(sealed["canary"][0] == jnp.uint32(CANARY),
-                           payload_checksum(sealed["p"]) == sealed["crc"][0])
 
 
 def inject_faults(sealed: dict, key: jax.Array,

@@ -51,6 +51,10 @@ from ..lint import graph_contract
 from ..utils.clock import MONOTONIC, Clock
 from .faults import (_CRC_MULT, _bump, inject_faults, seal_payload,
                      tree_nbytes, verify_payload)
+# the byte-stream flatten/unflatten moved to wire_format.py (the fused hops
+# cross the same flat layout); aliased to the historical private names
+from .wire_format import flatten_bytes as _flatten_bytes
+from .wire_format import unflatten_bytes as _unflatten_bytes
 
 #: folded into every chunk checksum word so an all-zero (dropped) chunk and
 #: its zeroed word can never agree
@@ -115,33 +119,6 @@ class HedgeConfig:
                 or self.routes < 2):
             raise ValueError(f"routes must be an integer >= 2, "
                              f"got {self.routes!r}")
-
-
-def _flatten_bytes(tree: Any) -> jnp.ndarray:
-    """Every leaf's bytes, concatenated in tree-flatten order -> (N,) uint8."""
-    parts = []
-    for leaf in jax.tree_util.tree_leaves(tree):
-        parts.append(jax.lax.bitcast_convert_type(leaf, jnp.uint8).reshape(-1))
-    return jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.uint8)
-
-
-def _unflatten_bytes(stream: jnp.ndarray, like: Any) -> Any:
-    """Inverse of :func:`_flatten_bytes` against a template tree (shapes and
-    dtypes are trace-time constants, so every slice is static)."""
-    leaves, treedef = jax.tree_util.tree_flatten(like)
-    out, off = [], 0
-    for leaf in leaves:
-        itemsize = leaf.dtype.itemsize
-        n = leaf.size * itemsize
-        b = stream[off:off + n]
-        off += n
-        if itemsize == 1:
-            x = jax.lax.bitcast_convert_type(b, leaf.dtype)
-        else:
-            x = jax.lax.bitcast_convert_type(b.reshape(-1, itemsize),
-                                             leaf.dtype)
-        out.append(x.reshape(leaf.shape))
-    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def _chunk_words(chunks: jnp.ndarray) -> jnp.ndarray:

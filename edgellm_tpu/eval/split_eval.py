@@ -584,9 +584,14 @@ def run_split_eval(
     if time_hops and rd.chunks:
         t_seq = seq if n_seq <= 1 else seq + (-seq) % n_seq
         # after a failover, time the boundary that actually finished the run
+        timed_rt = runtimes[0] if rcounters.failovers else rt
         with obs_span("eval.time_hops", seq=t_seq):
-            result["per_hop_ms"] = (runtimes[0] if rcounters.failovers
-                                    else rt).time_hops(1, t_seq)
+            result["per_hop_ms"] = timed_rt.time_hops(1, t_seq)
+        # the ring runtime is a whole-window forward — no per-token decode
+        # surface, so nothing to time at the (B, 1, D) shape
+        if hasattr(timed_rt, "time_decode_hops"):
+            with obs_span("eval.time_decode_hops"):
+                result["per_decode_hop_ms"] = timed_rt.time_decode_hops(1)
     # mirror this sweep's totals into the global registry (no-ops when
     # observability is off): wire bytes, fault/health/recovery counters
     record_wire_bytes(hop_bytes_total, kind="eval_forward")

@@ -46,7 +46,8 @@ def make_seq_mesh(n_seq: int, devices=None) -> Mesh:
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str = "seq", capture_stats: bool = False):
+                   axis_name: str = "seq", capture_stats: bool = False,
+                   kv_codec=None):
     """Causal ring attention over locally-sharded (B, S_loc, H, hd) query blocks.
 
     Must run inside ``shard_map`` with the sequence sharded on ``axis_name``.
@@ -74,6 +75,17 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     skips the value matmul (~half an attention pass, only when stats are
     requested). Returns ``(out, (col_sum/S, last_row))`` with stats on,
     plain ``out`` otherwise (a bare array composes with shard_map out_specs).
+
+    ``kv_codec`` (a batch-invariant :class:`~edgellm_tpu.codecs.packing.
+    WireCodec`, opt-in) is the fused-quantized-collective trick applied to
+    the ring's all-gather: each device encodes its home K/V blocks ONCE,
+    the two packed payloads circulate as a single flat uint8 buffer (one
+    ppermute per rotation step instead of one per K/V leaf), and every
+    step dequantizes the arrived payload locally. Quantization happens
+    exactly once per block — no per-hop re-encode, so error does not
+    compound around the ring (EQuARX-style). Lossy by construction; None
+    (the default) leaves the graph byte-identical to the uncompressed
+    ring.
     """
     n = axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -88,6 +100,23 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     k_blk, v_blk = k, v
     ring = [(i, (i + 1) % n) for i in range(n)]
 
+    if kv_codec is not None:
+        from ..codecs.wire_format import flatten_bytes, unflatten_bytes
+
+        kv = k.shape[2]
+        k_payload = kv_codec.encode(k.reshape(b, s_loc, kv * hd))
+        v_payload = kv_codec.encode(v.reshape(b, s_loc, kv * hd))
+        kv_spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            {"k": k_payload, "v": v_payload})
+        kv_wire = flatten_bytes({"k": k_payload, "v": v_payload})
+
+        def kv_decode(buf):
+            p = unflatten_bytes(buf, kv_spec)
+            dk = kv_codec.decode(p["k"]).reshape(b, s_loc, kv, hd)
+            dv = kv_codec.decode(p["v"]).reshape(b, s_loc, kv, hd)
+            return dk.astype(k.dtype), dv.astype(v.dtype)
+
     def scores_for(k_blk, src):
         k_pos = src * s_loc + jnp.arange(s_loc)
         k_t = jnp.repeat(k_blk, rep, axis=2) if rep > 1 else k_blk
@@ -98,6 +127,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     for t in range(n):
         src = (idx - t) % n  # which global block this K/V is
+        if kv_codec is not None:
+            # every device decodes the payload that just arrived; blocks were
+            # quantized exactly once, at home, before the first rotation
+            k_blk, v_blk = kv_decode(kv_wire)
         scores, mask = scores_for(k_blk, src)
         v_t = jnp.repeat(v_blk, rep, axis=2) if rep > 1 else v_blk
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
@@ -109,8 +142,13 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             preferred_element_type=jnp.float32)
         m = m_new
         if t < n - 1:
-            k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
-            v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
+            if kv_codec is not None:
+                # ONE ppermute per step over the packed buffer instead of one
+                # per K/V leaf — the quantized-collective trick on the ring
+                kv_wire = jax.lax.ppermute(kv_wire, axis_name, ring)
+            else:
+                k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+                v_blk = jax.lax.ppermute(v_blk, axis_name, ring)
 
     out = acc / jnp.maximum(l, 1e-30)[..., None]  # (B, H, S_loc, hd)
     out = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
@@ -122,11 +160,22 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # their K block and land home after n hops
     l_safe = jnp.maximum(l, 1e-30)
     k_blk = k
+    if kv_codec is not None:
+        from ..codecs.wire_format import flatten_bytes, unflatten_bytes
+        kv = k.shape[2]
+        k_spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), k_payload)
+        # rebuild a K-only wire buffer from the home payload saved in pass 1;
+        # the f32 accumulators stay raw — they carry exact statistics
+        k_wire = flatten_bytes(k_payload)
     col_acc = jnp.zeros((b, h, s_loc), jnp.float32)
     last_acc = jnp.zeros((b, h, s_loc), jnp.float32)
     is_last = (idx == n - 1)  # device holding the globally-last query row
     for t in range(n):
         src = (idx - t) % n
+        if kv_codec is not None:
+            k_blk = kv_codec.decode(unflatten_bytes(k_wire, k_spec)) \
+                .reshape(b, s_loc, kv, hd).astype(k.dtype)
         scores, mask = scores_for(k_blk, src)
         probs = jnp.exp(scores - m[..., None]) * mask[None, None] \
             / l_safe[..., None]  # (B, H, S_loc_q, S_loc_k), exact
@@ -134,7 +183,10 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         last_acc = last_acc + jnp.where(is_last, probs[:, :, -1, :], 0.0)
         # permute on EVERY step (unlike pass 1) so block and accumulators
         # complete the full circle back to the block's home device
-        k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
+        if kv_codec is not None:
+            k_wire = jax.lax.ppermute(k_wire, axis_name, ring)
+        else:
+            k_blk = jax.lax.ppermute(k_blk, axis_name, ring)
         col_acc = jax.lax.ppermute(col_acc, axis_name, ring)
         last_acc = jax.lax.ppermute(last_acc, axis_name, ring)
     s_total = n * s_loc
@@ -142,7 +194,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 
 def _sp_attention(cfg: ModelConfig, lp: dict, x, cos_loc, sin_loc, axis_name,
-                  capture_stats: bool = False):
+                  capture_stats: bool = False, kv_codec=None):
     """Per-layer attention with ring communication; x is (B, S_loc, D)."""
     b, s_loc, d = x.shape
     h, kv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -158,9 +210,11 @@ def _sp_attention(cfg: ModelConfig, lp: dict, x, cos_loc, sin_loc, axis_name,
     # GQA: the unexpanded KV-head blocks circulate the ring; ring_attention
     # broadcasts heads locally per step
     if capture_stats:
-        out, stats = ring_attention(q, k, v, axis_name, capture_stats=True)
+        out, stats = ring_attention(q, k, v, axis_name, capture_stats=True,
+                                    kv_codec=kv_codec)
     else:
-        out, stats = ring_attention(q, k, v, axis_name), None
+        out, stats = ring_attention(q, k, v, axis_name,
+                                    kv_codec=kv_codec), None
     out = out.reshape(b, s_loc, h * hd) @ lp["wo"]
     if "bo" in lp:
         out = out + lp["bo"]
@@ -168,25 +222,25 @@ def _sp_attention(cfg: ModelConfig, lp: dict, x, cos_loc, sin_loc, axis_name,
 
 
 def _sp_block(cfg: ModelConfig, lp: dict, hidden, cos_loc, sin_loc, axis_name,
-              capture_stats: bool = False):
+              capture_stats: bool = False, kv_codec=None):
     """Decoder block with ring attention; norms/MLP are per-token (trivially SP).
     Returns ``(hidden, stats)`` — stats None unless ``capture_stats``."""
     if cfg.family == "gpt_neox":
         attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
         attn_out, stats = _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc,
-                                        axis_name, capture_stats)
+                                        axis_name, capture_stats, kv_codec)
         mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
         return hidden + attn_out + mlp(cfg, lp, mlp_in), stats
     attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
     attn_out, stats = _sp_attention(cfg, lp, attn_in, cos_loc, sin_loc,
-                                    axis_name, capture_stats)
+                                    axis_name, capture_stats, kv_codec)
     hidden = hidden + attn_out
     mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
     return hidden + mlp(cfg, lp, mlp_in), stats
 
 
 @functools.lru_cache(maxsize=None)
-def _sp_forward(cfg: ModelConfig, mesh: Mesh, axis_name: str):
+def _sp_forward(cfg: ModelConfig, mesh: Mesh, axis_name: str, kv_codec=None):
     @jax.jit
     def fn(params, input_ids):
         seq = input_ids.shape[1]
@@ -199,7 +253,8 @@ def _sp_forward(cfg: ModelConfig, mesh: Mesh, axis_name: str):
             hidden = embed(params, ids_loc)  # already ring-varying via ids_loc
 
             def scan_body(h, lp):
-                out, _ = _sp_block(cfg, lp, h, cos_loc, sin_loc, axis_name)
+                out, _ = _sp_block(cfg, lp, h, cos_loc, sin_loc, axis_name,
+                                   kv_codec=kv_codec)
                 return out, None
 
             hidden, _ = jax.lax.scan(scan_body, hidden, params["layers"])
@@ -215,11 +270,14 @@ def _sp_forward(cfg: ModelConfig, mesh: Mesh, axis_name: str):
 
 
 def forward_sp(cfg: ModelConfig, params, input_ids, mesh: Mesh,
-               axis_name: str = "seq") -> jnp.ndarray:
+               axis_name: str = "seq", kv_codec=None) -> jnp.ndarray:
     """Sequence-parallel forward: ids (B, S) with S sharded over ``axis_name`` ->
     full fp32 logits. Weights replicated, activations 1/n per device, attention
-    via the K/V ring."""
-    return _sp_forward(cfg, mesh, axis_name)(params, jnp.asarray(input_ids))
+    via the K/V ring. ``kv_codec`` (opt-in, lossy) compresses the circulating
+    K/V blocks into a single packed wire buffer per rotation step — see
+    :func:`ring_attention`."""
+    return _sp_forward(cfg, mesh, axis_name, kv_codec)(
+        params, jnp.asarray(input_ids))
 
 
 @functools.lru_cache(maxsize=None)

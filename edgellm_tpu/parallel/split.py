@@ -40,6 +40,7 @@ from ..models.transformer import (block, block_decode, embed, unembed,
 from ..models.paged_kv import block_decode_paged
 from ..codecs.packing import get_wire_codec, WireCodec
 from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
+from ..codecs.pallas_kernels import fused_hop, fused_hop_plan
 from ..lint import graph_contract
 from ..serve.recovery import StageLostError
 from ..utils.jax_compat import shard_map, pcast_varying
@@ -114,7 +115,7 @@ def regroup_layers(layers: dict, bounds: list, stage_size: int) -> tuple:
 
 def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
                         hop_imps=None, axis_name: str = "stage",
-                        link=None, fault_key=None):
+                        link=None, fault_key=None, fused_plans=None):
     """The pipeline-unroll + boundary-hop protocol, shared by SplitRuntime and
     the stage x seq SplitRingRuntime (must run inside shard_map on
     ``axis_name``).
@@ -130,7 +131,14 @@ def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
     hop through the faulty-wire protocol — seal, inject, verify, retry — keyed
     by ``fault_key``; the return value then becomes ``(out, counters)`` with
     the per-hop counters psum-replicated over ``axis_name``. With ``link``
-    None this is byte-for-byte the original lossless path."""
+    None this is byte-for-byte the original lossless path.
+
+    ``fused_plans`` (one :class:`~edgellm_tpu.codecs.pallas_kernels.
+    FusedHopPlan`-or-None per cut, resolved by ``fused_hop_plan``) routes a
+    hop through the fused quantize->transport path instead; an all-None
+    plan list leaves this function byte-for-byte the pre-fusion graph, and
+    plans are only ever resolved when ``link`` is None (the gate refuses
+    under an active link)."""
     idx = jax.lax.axis_index(axis_name)
     counters = link.init_counters(n_stages - 1) if link is not None else None
     for s in range(n_stages):
@@ -142,6 +150,10 @@ def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
                 hidden, counters = link.hop(codecs[s], hidden, s, axis_name,
                                             idx, fault_key, counters,
                                             hop_imp=imp)
+                continue
+            if fused_plans is not None and fused_plans[s] is not None:
+                hidden = fused_hop(fused_plans[s], codecs[s], hidden, s,
+                                   axis_name, idx, n_dev=n_stages)
                 continue
             if codecs[s].needs_importance:
                 payload = codecs[s].encode(hidden, hop_imps[s])
@@ -160,7 +172,7 @@ def run_pipeline_stages(n_stages: int, codecs: list, run_stage, hidden,
 
 def run_pipeline_stages_carry(n_stages: int, codecs: list, run_stage, hidden,
                               carry, axis_name: str = "stage",
-                              link=None, fault_key=None):
+                              link=None, fault_key=None, fused_plans=None):
     """:func:`run_pipeline_stages` for stage bodies that thread stage-local
     state (the decode KV cache): ``run_stage(hidden, carry) -> (hidden,
     carry)``. Each device keeps the carry produced at ITS unroll step — the
@@ -181,6 +193,10 @@ def run_pipeline_stages_carry(n_stages: int, codecs: list, run_stage, hidden,
             if link is not None:
                 hidden, counters = link.hop(codecs[s], hidden, s, axis_name,
                                             idx, fault_key, counters)
+                continue
+            if fused_plans is not None and fused_plans[s] is not None:
+                hidden = fused_hop(fused_plans[s], codecs[s], hidden, s,
+                                   axis_name, idx, n_dev=n_stages)
                 continue
             payload = codecs[s].encode(hidden)
             moved = jax.tree_util.tree_map(
@@ -205,13 +221,20 @@ def hop_payload_bytes(codecs, cfg, batch: int, seq: int) -> list:
 
 
 def measure_hop_times(mesh, codecs, cfg, batch: int, seq: int, *,
-                      iters: int = 20, hidden_spec: P = P()) -> list:
+                      iters: int = 20, warmup: int = 1,
+                      hidden_spec: P = P()) -> list:
     """Per-hop boundary-transfer time (ms): encode -> ppermute over "stage" ->
     decode, isolated from stage compute. ``hidden_spec`` places the probe
     activation on the mesh (replicated for the plain split runtime,
     seq-sharded ``P(None, "seq")`` for the stage x seq runtime, which times the
-    local-shard payloads its hops actually move)."""
+    local-shard payloads its hops actually move).
+
+    ``warmup`` is clamped to >= 1: the first call compiles the hop
+    executable, and a compile second leaking into a per-hop millisecond
+    poisons every downstream SLO/bench number (the BENCH_SOAK rule)."""
     from ..utils.profiling import timed
+
+    warmup = max(1, int(warmup))
 
     results = []
     hidden = jax.random.normal(
@@ -244,7 +267,7 @@ def measure_hop_times(mesh, codecs, cfg, batch: int, seq: int, *,
         fn = jax.jit(shard_map(hop_body, mesh=mesh,
                                in_specs=(hidden_spec, imp_spec),
                                out_specs=hidden_spec, check_vma=False))
-        sec, _ = timed(fn, hidden, imp, warmup=1, iters=iters)
+        sec, _ = timed(fn, hidden, imp, warmup=warmup, iters=iters)
         results.append(sec * 1000.0)
     return results
 
@@ -340,6 +363,15 @@ class SplitRuntime:
         self.stage_size = max(stop - start for start, stop in self.bounds)
         self.codecs: list[WireCodec] = apply_default_codec_backend(
             list(split.hop_codecs))
+        # per-cut fused-transport decision, resolved ONCE at build time so
+        # the compiled graphs embed it: None = the pre-fusion ladder (an
+        # all-None list leaves every traced graph byte-identical — the
+        # "split.*.fused-disabled-identity" lint checks pin this). The gate
+        # refuses whenever the faulty link is armed: fault injection, FEC
+        # and hedging own the hop there.
+        self.fused_plans: list = [
+            fused_hop_plan(c, link_active=self._link is not None)
+            for c in self.codecs]
         n_model = mesh.shape["model"]
         if n_model > 1:
             bad = [(name, dim) for name, dim in
@@ -438,6 +470,7 @@ class SplitRuntime:
         codecs = self.codecs
         mesh = self.mesh
         link = self._link
+        fused_plans = self.fused_plans
 
         tp_axis = "model" if mesh.shape["model"] > 1 else None
 
@@ -463,7 +496,7 @@ class SplitRuntime:
 
             if link is None:
                 return run_pipeline_stages(n_stages, codecs, run_stage, hidden,
-                                           hop_imps)
+                                           hop_imps, fused_plans=fused_plans)
             # one fold per forward call keeps chunks decorrelated while two
             # same-seed runs replay the identical fault sequence
             key = jax.random.fold_in(jax.random.key(link.faults.seed),
@@ -516,6 +549,15 @@ class SplitRuntime:
         "split.forward",
         # one ppermute per payload leaf per cut, one structural psum; the
         # driver supplies the measured counts/bytes from the codec registry
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"])
+    @graph_contract(
+        "split.forward.fused",
+        # fused wire mode: the whole sealed tree crosses each cut as ONE
+        # flat uint8 buffer (hop_eqns == n_cuts), and the bytes are exactly
+        # hop_bytes + the 8-byte canary/crc seal per cut — the driver traces
+        # a forced-fused build against this declaration
         collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
         wire_dtypes=lambda ctx: ctx["wire_dtypes"],
         wire_bytes=lambda ctx: ctx["wire_bytes"])
@@ -594,7 +636,10 @@ class SplitRuntime:
         return [{"hop": i, "codec": self.codecs[i].name,
                  "forward_bytes": int(fwd[i]),
                  "decode_step_bytes": int(dec[i]) if i < len(dec) else 0,
-                 "bytes_per_token": float(per_tok[i])}
+                 "bytes_per_token": float(per_tok[i]),
+                 "fused": (None if self.fused_plans[i] is None else
+                           {"mode": self.fused_plans[i].mode,
+                            "reason": self.fused_plans[i].reason})}
                 for i in range(len(self.codecs))]
 
     # ---------- incremental decode ----------
@@ -627,13 +672,15 @@ class SplitRuntime:
         codecs, mesh = self.codecs, self.mesh
         layer_pspec = self._layer_pspec
         link = self._link
+        fused_plans = self.fused_plans
 
         def _hop_protocol(run_stage, hidden, carry, fault_key):
             """Dispatch the carry protocol with or without the faulty link —
             the link-free branch is byte-for-byte the original call."""
             if link is None:
                 out, c = run_pipeline_stages_carry(
-                    n_stages, codecs, run_stage, hidden, carry)
+                    n_stages, codecs, run_stage, hidden, carry,
+                    fused_plans=fused_plans)
                 return out, c, None
             return run_pipeline_stages_carry(
                 n_stages, codecs, run_stage, hidden, carry,
@@ -784,6 +831,15 @@ class SplitRuntime:
         wire_dtypes=lambda ctx: ctx["wire_dtypes"],
         wire_bytes=lambda ctx: ctx["wire_bytes"],
         donate=lambda ctx: ctx.get("donate_min", 2))
+    @graph_contract(
+        "split.decode_step.fused",
+        # decode-shape twin of split.forward.fused: one flat sealed buffer
+        # per cut at (B, 1, D), byte-checked against decode_hop_bytes + 8,
+        # with the KV donation discipline intact under fusion
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"],
+        donate=lambda ctx: ctx.get("donate_min", 2))
     def decode_step(self, placed_params: dict, cache: dict,
                     token_ids: jnp.ndarray) -> tuple:
         """One decode position across the pipeline: each cut quantizes the
@@ -861,11 +917,13 @@ class SplitRuntime:
         codecs, mesh = self.codecs, self.mesh
         layer_pspec = self._layer_pspec
         link = self._link
+        fused_plans = self.fused_plans
 
         def _hop_protocol(run_stage, hidden, carry, fault_key):
             if link is None:
                 out, c = run_pipeline_stages_carry(
-                    n_stages, codecs, run_stage, hidden, carry)
+                    n_stages, codecs, run_stage, hidden, carry,
+                    fused_plans=fused_plans)
                 return out, c, None
             return run_pipeline_stages_carry(
                 n_stages, codecs, run_stage, hidden, carry,
@@ -981,11 +1039,23 @@ class SplitRuntime:
         """Per-hop boundary bytes per token (the BASELINE.json metric)."""
         return [b / seq for b in self.hop_bytes(1, seq)]
 
-    def time_hops(self, batch: int, seq: int, iters: int = 20) -> list:
+    def time_hops(self, batch: int, seq: int, iters: int = 20,
+                  warmup: int = 1) -> list:
         """Measured per-hop boundary-transfer time (ms): encode -> ppermute ->
         decode of one (batch, seq, D) activation, isolated from the stage
         compute so the observability numbers attribute wire cost separately
         (the reference has no transfer at all to time — SURVEY.md section 5).
-        """
+        Always pre-warmed (``warmup`` clamps to >= 1) so compile seconds
+        never pollute the per-hop ms."""
         return measure_hop_times(self.mesh, self.codecs, self.cfg, batch, seq,
-                                 iters=iters)
+                                 iters=iters, warmup=warmup)
+
+    def time_decode_hops(self, batch: int = 1, iters: int = 20,
+                         warmup: int = 1) -> list:
+        """:meth:`time_hops` at the decode shape — one (batch, 1, D) token
+        per step, the regime where codec overhead dominates the hop and
+        where an unwarmed jit would mis-report compile time as transfer
+        time (the per-hop payload is a few KB; the first-call compile is
+        seconds)."""
+        return measure_hop_times(self.mesh, self.codecs, self.cfg, batch, 1,
+                                 iters=iters, warmup=warmup)
