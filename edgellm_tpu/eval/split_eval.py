@@ -36,7 +36,8 @@ from ..codecs.packing import WireCodec, get_wire_codec, selective_int4
 from ..codecs.faults import FaultConfig, LinkPolicy, TierController, sum_counters
 from ..codecs.fec import FECConfig, HedgeConfig, LinkHealth, LinkHealthConfig
 from ..obs.metrics import (record_link_counters, record_link_health,
-                           record_recovery_counters, record_wire_bytes)
+                           record_probe_decisions, record_recovery_counters,
+                           record_wire_bytes)
 from ..obs.tracing import span as obs_span
 from ..utils.clock import MONOTONIC
 from ..serve.recovery import (DecodeTimeout, RecoveryCounters, StageFailure,
@@ -593,8 +594,12 @@ def run_split_eval(
             with obs_span("eval.time_decode_hops"):
                 result["per_decode_hop_ms"] = timed_rt.time_decode_hops(1)
     # mirror this sweep's totals into the global registry (no-ops when
-    # observability is off): wire bytes, fault/health/recovery counters
+    # observability is off): wire bytes, fault/health/recovery counters,
+    # and the per-hop fused-probe decisions (why a hop did/didn't fuse)
     record_wire_bytes(hop_bytes_total, kind="eval_forward")
+    final_rt = runtimes[0] if recovery_on and rcounters.failovers else rt
+    if hasattr(final_rt, "wire_summary"):
+        record_probe_decisions(final_rt.wire_summary(1, seq))
     if fault_on:
         record_link_counters(result["link_counters"])
         if health is not None:

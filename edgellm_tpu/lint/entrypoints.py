@@ -268,6 +268,40 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
             lowerable=pstep_fn,
             lower_args=(placed, spool["k"], spool["v"], ptab, plens, ptoks))
 
+    # ---- k-token verify: the speculative burst's ONE boundary round-trip —
+    # ---- every cut quantizes a single (B, K, D) activation block instead of
+    # ---- K single-token payloads, KV donation discipline unchanged ---------
+    K = 4  # verify window; any k traces the same contract shape
+    verify_shape = (BATCH, K, cfg.hidden_size)
+    leaves_v, dtypes_v, _ = _payload_info(rt.codecs[0], verify_shape)
+    verify_fn = rt._verify_fns(CAPACITY, K)
+    vtoks = jnp.zeros((BATCH, K), jnp.int32)
+    verify_ctx = {
+        "hop_eqns": n_hops * leaves_v,
+        "wire_dtypes": frozenset(dtypes_v),
+        "wire_bytes": sum(rt.verify_hop_bytes(BATCH, K)),
+        "donate_min": 2,  # the burst updates both KV caches in place
+    }
+    run_one("split.verify_step", verify_fn,
+            (placed, k_cache, v_cache, length, vtoks), verify_ctx,
+            lowerable=verify_fn,
+            lower_args=(placed, k_cache, v_cache, length, vtoks))
+
+    # a disabled SpecConfig is pure host-side dispatch: a runtime whose
+    # verify executables HAVE been built must still trace the byte-identical
+    # vanilla decode step (the pre-spec graph) — this is the fingerprint
+    # half of the ISSUE's disabled-spec contract; run.py's validator and the
+    # serve loop's dispatch guard are the other half
+    rt_prespec = SplitRuntime(cfg, split, mesh)
+    _, step_fn_prespec = rt_prespec._decode_fns(CAPACITY)
+    ident = check_identity(
+        "split.decode_step.spec-disabled-identity",
+        step_fn, (placed, k_cache, v_cache, length, tok),
+        step_fn_prespec, (placed, k_cache, v_cache, length, tok),
+        what="spec-aware build's vanilla decode-step graph")
+    (findings.extend(ident) if ident
+     else checked.append("split.decode_step.spec-disabled-identity"))
+
     # ---- faulty link: sealed payloads, statically-unrolled retries ------
     attempts = 2  # 1 try + 1 retry, statically unrolled in the graph
     rt_fault = SplitRuntime(cfg, split, mesh,
@@ -387,6 +421,21 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
                 (placed, k_cache, v_cache, length, tok), fused_step_ctx,
                 lowerable=step_fn_fused,
                 lower_args=(placed, k_cache, v_cache, length, tok))
+
+        # verify-shape twin: the whole (B, K, D) burst block crosses each cut
+        # as ONE flat sealed buffer — K x hop_bytes payload + the 8-byte seal
+        verify_fn_fused = rt_fused._verify_fns(CAPACITY, K)
+        fused_verify_ctx = {
+            "hop_eqns": n_hops,
+            "wire_dtypes": frozenset({"uint8"}),
+            "wire_bytes": sum(rt_fused.verify_hop_bytes(BATCH, K))
+            + 8 * n_hops,
+            "donate_min": 2,
+        }
+        run_one("split.verify_step.fused", verify_fn_fused,
+                (placed, k_cache, v_cache, length, vtoks), fused_verify_ctx,
+                lowerable=verify_fn_fused,
+                lower_args=(placed, k_cache, v_cache, length, vtoks))
 
     ident = check_identity(
         "split.forward.fused-disabled-identity",

@@ -573,6 +573,40 @@ def decode_attention(q, k_cache, v_cache, length):
     return out.reshape(b, 1, h, hd)
 
 
+def verify_attention(q, k_cache, v_cache, length):
+    """q_len=k attention against a cache for speculative verify: q
+    (B, K, H, hd) holds K consecutive positions whose K/V were just written
+    at cache rows ``length .. length+K-1``, so query row j attends cache
+    positions ``[0, length + j]`` — the per-query causal mask is the only
+    difference from :func:`decode_attention`, whose einsum/mask/softmax
+    structure this clones with the K axis kept. ``length`` is a traced
+    scalar (the pre-write fill level). At K=1 this reduces exactly to
+    ``decode_attention(q, k_cache, v_cache, length + 1)``.
+    Returns (B, K, H, hd) in q's dtype; softmax in fp32.
+    """
+    b, kq, h, hd = q.shape
+    kv = k_cache.shape[2]
+    rep = h // kv
+    if h % kv:
+        raise ValueError(f"ragged GQA: H={h}, KV={kv}")
+    # no kernel plan to consult: the verify shape is (tiny K) x (cache read),
+    # the same HBM-bound regime where decode_plan returns None for contiguous
+    # caches — XLA's fused path is the only implementation
+    qg = q.reshape(b, kq, kv, rep, hd)
+    scores = jnp.einsum("bqgrd,bcgd->bqgrc", qg, k_cache,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (1.0 / np.sqrt(hd))
+    # query row j sees positions < length + j + 1 (its own row included)
+    valid = (jnp.arange(k_cache.shape[1])[None, :]
+             < (length + jnp.arange(kq)[:, None] + 1))  # (K, capacity)
+    scores = jnp.where(valid[None, :, None, None, :], scores,
+                       jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bqgrc,bcgd->bqgrd", probs.astype(q.dtype), v_cache,
+                     preferred_element_type=jnp.float32).astype(q.dtype)
+    return out.reshape(b, kq, h, hd)
+
+
 # ---------------------------------------------------------------------------
 # Paged ragged decode attention: q_len=1 per slot against that slot's page
 # list. Pallas kernel on TPU (plan-gated), XLA gather fallback everywhere.

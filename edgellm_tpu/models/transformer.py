@@ -565,6 +565,63 @@ def block_decode(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray,
     return hidden + mlp(cfg, lp, mlp_in, tp_axis), k_cache, v_cache
 
 
+def _attention_verify(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+                      cos_t, sin_t, k_cache, v_cache, pos,
+                      tp_axis: Optional[str] = None):
+    """The K-position twin of :func:`_attention_decode` for speculative
+    verify: project the (B, K, D) hidden block, rotate at positions
+    ``pos .. pos+K-1`` (``cos_t``/``sin_t`` are the (K, rot) row slices),
+    write all K new K/V rows into the cache at ``pos``, then attend q_len=K
+    causally against the cache. Returns (out, k_cache, v_cache)."""
+    b, kq, d = x.shape
+    hd = cfg.head_dim
+    h, kv = lp["wq"].shape[-1] // hd, lp["wk"].shape[-1] // hd
+    q = (x @ lp["wq"]).reshape(b, kq, h, hd)
+    k = (x @ lp["wk"]).reshape(b, kq, kv, hd)
+    v = (x @ lp["wv"]).reshape(b, kq, kv, hd)
+    if "bq" in lp:
+        q = q + lp["bq"].reshape(h, hd)
+        k = k + lp["bk"].reshape(kv, hd)
+        v = v + lp["bv"].reshape(kv, hd)
+    q = apply_rotary(q, cos_t, sin_t, cfg.rotary_dim)
+    k = apply_rotary(k, cos_t, sin_t, cfg.rotary_dim)
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k.astype(k_cache.dtype), (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v.astype(v_cache.dtype), (0, pos, 0, 0))
+
+    from .flash_attention import verify_attention
+
+    out = verify_attention(q, k_cache, v_cache, pos)
+    out = out.reshape(b, kq, h * hd) @ lp["wo"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    if "bo" in lp:
+        out = out + lp["bo"]
+    return out, k_cache, v_cache
+
+
+def block_verify(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray,
+                 cos_t, sin_t, k_cache, v_cache, pos,
+                 tp_axis: Optional[str] = None):
+    """The cache-carrying twin of :func:`block_decode` for a K-position
+    speculative-verify block. ``hidden`` is (B, K, D); ``pos`` is the (traced)
+    first position being written. Returns (hidden, k_cache, v_cache)."""
+    if cfg.family == "gpt_neox":
+        attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"], cfg.norm_eps)
+        attn_out, k_cache, v_cache = _attention_verify(
+            cfg, lp, attn_in, cos_t, sin_t, k_cache, v_cache, pos, tp_axis)
+        mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"], cfg.norm_eps)
+        return (hidden + attn_out + mlp(cfg, lp, mlp_in, tp_axis),
+                k_cache, v_cache)
+    attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
+    attn_out, k_cache, v_cache = _attention_verify(
+        cfg, lp, attn_in, cos_t, sin_t, k_cache, v_cache, pos, tp_axis)
+    hidden = hidden + attn_out
+    mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
+    return hidden + mlp(cfg, lp, mlp_in, tp_axis), k_cache, v_cache
+
+
 @graph_contract("transformer.decode_step", collectives={})
 def decode_step(cfg: ModelConfig, params: dict, cache: KVCache,
                 token_ids: jnp.ndarray, *,

@@ -24,7 +24,12 @@ Metric name catalog (REPRODUCING §10): ``edgellm_link_<counter>_total``
 (burn rate / windowed rates / tier), ``edgellm_recovery_<counter>_total``,
 ``edgellm_decode_jit_cache_misses_total``, ``edgellm_wire_bytes_total``
 (labels ``hop``, ``kind``), ``edgellm_decode_ttft_seconds`` /
-``edgellm_decode_token_latency_seconds`` (histograms).
+``edgellm_decode_token_latency_seconds`` (histograms),
+``edgellm_spec_{drafted,accepted,rejected,bursts}_total`` /
+``edgellm_spec_acceptance_rate`` / ``edgellm_spec_hops_per_token``
+(speculative decode), ``edgellm_fused_hop_active`` /
+``edgellm_fused_hop_decision`` / ``edgellm_fused_probe_win`` (fused-hop
+probe decisions, labels ``hop``, ``codec``, ``mode``, ``reason``).
 """
 from __future__ import annotations
 
@@ -38,8 +43,8 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Protocol, \
 __all__ = [
     "Counter", "CounterSource", "Gauge", "Histogram", "MetricsRegistry",
     "format_table", "get_registry", "record_decode_stats",
-    "record_link_counters", "record_link_health", "record_recovery_counters",
-    "record_wire_bytes",
+    "record_link_counters", "record_link_health", "record_probe_decisions",
+    "record_recovery_counters", "record_spec_stats", "record_wire_bytes",
 ]
 
 LabelKey = Tuple[Tuple[str, str], ...]
@@ -412,6 +417,73 @@ def record_wire_bytes(per_hop_bytes: Optional[Iterable[float]],
         total = float(b) * int(steps)
         if total:
             c.inc(total, hop=hop, kind=kind)
+
+
+def record_spec_stats(stats: Optional[Mapping[str, Any]],
+                      registry: Optional[MetricsRegistry] = None) -> None:
+    """Absorb a speculative-decode stats dict (``generate_speculative``'s
+    ``stats["speculative"]``): drafted/accepted/rejected/burst counters plus
+    acceptance-rate and hops-per-token gauges — the two numbers that say
+    whether speculation is paying for its drafts."""
+    reg = registry if registry is not None else _REGISTRY
+    if not reg.enabled or not stats:
+        return
+    for key in ("drafted", "accepted", "rejected", "bursts"):
+        v = stats.get(key)
+        if v:
+            reg.counter(f"edgellm_spec_{key}_total",
+                        f"speculative-decode counter {key!r}").inc(int(v))
+    ar = stats.get("acceptance_rate")
+    if ar is not None:
+        reg.gauge("edgellm_spec_acceptance_rate",
+                  "accepted drafts / drafted tokens, last run").set(float(ar))
+    hpt = stats.get("hops_per_token")
+    if hpt is not None:
+        reg.gauge("edgellm_spec_hops_per_token",
+                  "boundary hop rounds per emitted token, last run "
+                  "(< 1.0 means speculation amortized the link)"
+                  ).set(float(hpt))
+
+
+def record_probe_decisions(rows: Optional[Sequence[Mapping[str, Any]]],
+                           registry: Optional[MetricsRegistry] = None
+                           ) -> None:
+    """Absorb ``SplitRuntime.wire_summary`` rows' fused-hop plan decisions,
+    plus the probe cache's measured-win verdict per codec, so
+    ``--metrics-out`` says WHY a hop did or didn't fuse instead of that
+    living only in the BENCH_WIRE detail sidecar: ``edgellm_fused_hop_active
+    {hop, codec}`` is 1/0, ``edgellm_fused_hop_decision{hop, codec, mode,
+    reason}`` is an info-style gauge carrying the plan's reason string, and
+    ``edgellm_fused_probe_win{codec}`` is 1 for a measured win, -1 for a
+    measured loss, 0 for no probe data."""
+    reg = registry if registry is not None else _REGISTRY
+    if not reg.enabled or not rows:
+        return
+    from ..codecs import probe_cache
+
+    active = reg.gauge("edgellm_fused_hop_active",
+                       "1 when this hop crosses as one fused sealed buffer, "
+                       "0 on the unfused encode/ppermute/decode ladder")
+    decision = reg.gauge("edgellm_fused_hop_decision",
+                         "info-style record (value always 1) of each hop's "
+                         "fuse/no-fuse decision and its reason")
+    win = reg.gauge("edgellm_fused_probe_win",
+                    "probe-cache verdict per codec: 1 measured win, "
+                    "-1 measured loss, 0 no data")
+    for row in rows:
+        hop = row.get("hop", 0)
+        codec = row.get("codec", "?")
+        fused = row.get("fused")
+        active.set(1.0 if fused else 0.0, hop=hop, codec=codec)
+        if fused:
+            decision.set(1.0, hop=hop, codec=codec,
+                         mode=fused.get("mode", "?"),
+                         reason=fused.get("reason", "?"))
+        else:
+            decision.set(1.0, hop=hop, codec=codec, mode="off",
+                         reason="no fused plan (gate ladder refused)")
+        w = probe_cache.measured_win(f"fused_hop:{codec}")
+        win.set(0.0 if w is None else (1.0 if w else -1.0), codec=codec)
 
 
 def format_table(registry: Optional[MetricsRegistry] = None,

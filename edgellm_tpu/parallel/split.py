@@ -35,8 +35,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.configs import ModelConfig
-from ..models.transformer import (block, block_decode, embed, unembed,
-                                  precompute_rope, KVCache)
+from ..models.transformer import (block, block_decode, block_verify, embed,
+                                  unembed, precompute_rope, KVCache)
 from ..models.paged_kv import block_decode_paged
 from ..codecs.packing import get_wire_codec, WireCodec
 from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
@@ -58,6 +58,19 @@ def _adopt_paged_impl(pool_k, pool_v, k_seq, v_seq, dest):
     flat_k = flat_k.at[:, :, dest].set(k_seq.astype(flat_k.dtype))
     flat_v = flat_v.at[:, :, dest].set(v_seq.astype(flat_v.dtype))
     return (flat_k.reshape(pool_k.shape), flat_v.reshape(pool_v.shape))
+
+
+@jax.jit
+def _gather_paged_impl(pool_k, pool_v, idx):
+    """Inverse of :func:`_adopt_paged_impl` for one stream: gather the
+    (n_stages, sz, n, KV, hd) K/V rows at flat token indices ``idx`` out of
+    the per-stage pools. NOT donated — the pool stays live (eviction frees
+    pages host-side; checkpointing must not consume the pool)."""
+    ns, sz, pn, ps = pool_k.shape[:4]
+    tail = pool_k.shape[4:]
+    flat_k = pool_k.reshape(ns, sz, pn * ps, *tail)
+    flat_v = pool_v.reshape(ns, sz, pn * ps, *tail)
+    return flat_k[:, :, idx], flat_v[:, :, idx]
 
 
 def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
@@ -399,6 +412,7 @@ class SplitRuntime:
         self._forward = self._build_forward()
         self._decode_fns_cache: dict = {}  # capacity -> (prefill_fn, step_fn)
         self._paged_fns_cache: dict = {}   # pool geometry -> step_fn
+        self._verify_fns_cache: dict = {}  # (capacity, k) -> verify_fn
 
     # ---------- stage liveness ----------
 
@@ -864,6 +878,152 @@ class SplitRuntime:
         boundary activation — bytes/token is this divided by ``batch``."""
         return hop_payload_bytes(self.codecs, self.cfg, batch, 1)
 
+    # ---------- speculative verify ----------
+    #
+    # The k-token twin of the decode step: serve/speculative drafts k tokens
+    # on stage 0 and this verifies them all in ONE split pass — each cut
+    # moves one quantized (B, k, D) activation block instead of k single-
+    # token hops, amortizing the boundary round-trip (and the whole
+    # faulty/FEC/hedge/fused hop ladder, which is shape-generic and flows
+    # unchanged) k-fold per accepted run.
+
+    def _verify_fns(self, capacity: int, k: int):
+        """Build (or fetch) the jitted q_len=k verify executable for one
+        (capacity, k) pair. Both are static (cache buffer shape / verify
+        window); the fill level rides as a traced scalar, so every verify
+        burst of a run reuses one executable — the spec loop is jit-miss-free
+        after the first burst."""
+        key = (capacity, k)
+        if key in self._verify_fns_cache:
+            return self._verify_fns_cache[key]
+        cfg, n_stages, sz = self.cfg, self.split.n_stages, self.stage_size
+        codecs, mesh = self.codecs, self.mesh
+        layer_pspec = self._layer_pspec
+        link = self._link
+        fused_plans = self.fused_plans
+
+        def _hop_protocol(run_stage, hidden, carry, fault_key):
+            if link is None:
+                out, c = run_pipeline_stages_carry(
+                    n_stages, codecs, run_stage, hidden, carry,
+                    fused_plans=fused_plans)
+                return out, c, None
+            return run_pipeline_stages_carry(
+                n_stages, codecs, run_stage, hidden, carry,
+                link=link, fault_key=fault_key)
+
+        def stage_verify(local_layers, local_valid, hidden, k_loc, v_loc,
+                         cos_t, sin_t, pos):
+            lv = {k2: v[0] for k2, v in local_layers.items()}
+            valid = local_valid[0]
+            hidden = pcast_varying(hidden, ("stage",))
+
+            def scan_body(h, xs):
+                lp, ok, kl, vl = xs
+                out, kl2, vl2 = block_verify(cfg, lp, h, cos_t, sin_t,
+                                             kl, vl, pos)
+                # padding layers are identity AND must not touch their cache
+                return jnp.where(ok, out, h), (jnp.where(ok, kl2, kl),
+                                               jnp.where(ok, vl2, vl))
+
+            def run_stage(h, cache):
+                kc, vc = cache
+                h2, (kc2, vc2) = jax.lax.scan(scan_body, h,
+                                              (lv, valid, kc, vc))
+                return h2, (kc2, vc2)
+
+            # the cache fill level keys the fault step, exactly like the
+            # single-token step: distinct per burst, identical across
+            # same-seed runs (a resumed run replays the same fill levels)
+            fkey = None if link is None else jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(link.faults.seed), 0x57E9),
+                pos)
+            out, (kc, vc), counters = _hop_protocol(
+                run_stage, hidden, (k_loc[0], v_loc[0]), fkey)
+            if link is None:
+                return out, kc[None], vc[None]
+            return out, kc[None], vc[None], counters
+
+        # same KV donation discipline as step_fn: each burst updates the
+        # (n_stages, sz, B, capacity) caches in place (the
+        # "split.verify_step" contract asserts the aliasing survives)
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def verify_fn(placed, k_cache, v_cache, length, token_ids):
+            hidden = embed(placed, token_ids)  # (B, k, D)
+            cos, sin = precompute_rope(cfg, capacity)
+            cos_t = jax.lax.dynamic_slice_in_dim(cos, length, k)
+            sin_t = jax.lax.dynamic_slice_in_dim(sin, length, k)
+            lspecs = {k2: layer_pspec(k2, v.ndim)
+                      for k2, v in placed["layers"].items()}
+            if link is None:
+                out, kc, vc = shard_map(
+                    stage_verify, mesh=mesh,
+                    in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                              P(), P(), P()),
+                    out_specs=(P(), P("stage"), P("stage")),
+                    check_vma=False,
+                )(placed["layers"], placed["layers_valid"], hidden,
+                  k_cache, v_cache, cos_t, sin_t, length)
+                return unembed(cfg, placed, out), kc, vc
+            out, kc, vc, counters = shard_map(
+                stage_verify, mesh=mesh,
+                in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                          P(), P(), P()),
+                out_specs=(P(), P("stage"), P("stage"), P()),
+                check_vma=False,
+            )(placed["layers"], placed["layers_valid"], hidden,
+              k_cache, v_cache, cos_t, sin_t, length)
+            return unembed(cfg, placed, out), kc, vc, counters
+
+        self._verify_fns_cache[key] = verify_fn
+        return verify_fn
+
+    @graph_contract(
+        "split.verify_step",
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"],
+        donate=lambda ctx: ctx.get("donate_min", 2))
+    @graph_contract(
+        "split.verify_step.fused",
+        # verify-shape twin of split.decode_step.fused: one flat sealed
+        # buffer per cut at (B, k, D) — the ISSUE's k x hop_bytes + 8 wire
+        # contract: ONE hop per verify burst, not k single-token hops
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"],
+        donate=lambda ctx: ctx.get("donate_min", 2))
+    def verify_step(self, placed_params: dict, cache: dict,
+                    token_ids: jnp.ndarray) -> tuple:
+        """Verify k drafted positions in one split pass: ``token_ids`` is
+        (B, k) — the last committed token followed by the k-1 draft tokens —
+        and each cut quantizes ONE (B, k, D) activation block through its
+        wire codec. All k K/V rows are written at ``cache["length"]``; the
+        returned cache claims all of them (``length + k``) and the caller
+        commits the accepted prefix by shrinking ``length`` (garbage past
+        the fill level is masked, so rollback is a length rewrite — no data
+        movement). Returns (logits (B, k, V) fp32, updated cache)."""
+        self._check_alive()
+        self._check_decode_supported()
+        capacity = cache["k"].shape[3]
+        kq = token_ids.shape[1]
+        verify_fn = self._verify_fns(int(capacity), int(kq))
+        if self._link is None:
+            logits, kc, vc = verify_fn(placed_params, cache["k"], cache["v"],
+                                       cache["length"], token_ids)
+        else:
+            logits, kc, vc, counters = verify_fn(
+                placed_params, cache["k"], cache["v"], cache["length"],
+                token_ids)
+            self._counter_accum.append(counters)
+        return logits, {"k": kc, "v": vc, "length": cache["length"] + kq}
+
+    def verify_hop_bytes(self, batch: int, k: int) -> list:
+        """Measured payload bytes per hop for ONE verify burst's (batch, k, D)
+        boundary activation — the whole burst's wire cost; divide by the
+        accepted run length for bytes/token."""
+        return hop_payload_bytes(self.codecs, self.cfg, batch, k)
+
     # ---------- paged incremental decode ----------
     #
     # The continuous-batching twin of the block above: per-stage KV caches
@@ -903,6 +1063,27 @@ class SplitRuntime:
         v_seq = cache["v"][:, :, row, :length]
         pk, pv = _adopt_paged_impl(pool["k"], pool["v"], k_seq, v_seq, dest)
         return {"k": pk, "v": pv}
+
+    def adopt_paged_rows(self, pool: dict, k_seq, v_seq,
+                         dest: np.ndarray) -> dict:
+        """Scatter an already-contiguous (n_stages, sz, n, KV, hd) K/V prefix
+        — a :meth:`gather_paged` payload, possibly round-tripped through a
+        checkpoint — into pool pages at flat token indices ``dest``. The
+        re-admission half of eviction for the split batcher."""
+        dest = jnp.asarray(dest, jnp.int32)
+        pk, pv = _adopt_paged_impl(pool["k"], pool["v"], jnp.asarray(k_seq),
+                                   jnp.asarray(v_seq), dest)
+        return {"k": pk, "v": pv}
+
+    def gather_paged(self, pool: dict, idx: np.ndarray) -> tuple:
+        """Gather one stream's (n_stages, sz, n, KV, hd) K/V prefix from pool
+        pages at flat token indices ``idx`` — byte-identical to the
+        contiguous cache rows :meth:`adopt_paged` scattered (the split twin
+        of ``PagedKVCache.gather_slot``, for eviction and checkpointing).
+        Returns host (k_seq, v_seq) numpy arrays; the pool is NOT consumed."""
+        idx = jnp.asarray(idx, jnp.int32)
+        k_seq, v_seq = _gather_paged_impl(pool["k"], pool["v"], idx)
+        return np.asarray(k_seq), np.asarray(v_seq)
 
     def _paged_decode_fns(self, num_pages: int, page_size: int):
         """Build (or fetch) the jitted ragged step executable for one pool

@@ -115,6 +115,7 @@ _PARAM_KEYS = {
     "deadline": "split", "stage_failure": "split", "recovery": "split",
     "serving": "serve",
     "batching": "serve",
+    "speculative": "serve",
     "max_compiles": "distances",
     "observability": "all",
 }
@@ -171,9 +172,6 @@ def _validate_params_json(p: dict) -> None:
         die("serving only applies to experiment 'serve'")
     if exp != "serve" and "batching" in p:
         die("batching only applies to experiment 'serve'")
-    if "batching" in p and "cuts" in p:
-        die("batching drives the local paged pool; the split pipeline serves "
-            "through the soak path — drop 'batching' or 'cuts'")
     for k in _REQUIRED.get(exp, ()):
         if k not in p:
             die(f"experiment {exp!r} requires key {k!r}")
@@ -383,6 +381,36 @@ def _validate_params_json(p: dict) -> None:
         if need > bcfg.span:
             die(f"batching: soak requests need {need} cache positions > slot "
                 f"span {bcfg.span} (pages_per_slot x page_size)")
+    if "speculative" in p:
+        from .serve.speculative import SpecConfig
+
+        if exp != "serve":
+            die("speculative only applies to experiment 'serve'")
+        if "cuts" not in p:
+            die("speculative decode verifies across the boundary — add "
+                "'cuts'/'hop_codecs'")
+        sp = p["speculative"]
+        if not isinstance(sp, dict):
+            die(f"speculative must be an object of SpecConfig fields, "
+                f"got {sp!r}")
+        fields = {f.name for f in dataclasses.fields(SpecConfig)}
+        bad = sorted(set(sp) - fields)
+        if bad:
+            die(f"speculative: unknown field(s) {bad}; "
+                f"known: {sorted(fields)}")
+        try:
+            sc = SpecConfig(**sp)
+        except (TypeError, ValueError) as e:
+            die(f"speculative: {e}")
+        if sc.enabled and p.get("fused_hops") == "remote":
+            # forcing remote fusion skips the probe; the k-token verify
+            # shape has no measured win yet, so refuse until probed
+            die("speculative + fused_hops 'remote': forced remote fusion is "
+                "unprobed at the k-token verify shape — use 'auto' or 'off'")
+        if sc.enabled and "batching" in p:
+            die("speculative runs the one-stream spec loop; the batcher's "
+                "ragged step verifies one token per slot — drop "
+                "'speculative' or 'batching'")
 
 
 def _serve_front_config(sv: dict):
@@ -676,55 +704,6 @@ def main(argv=None) -> int:
             front_cfg = _serve_front_config(sv)
             soak = SoakConfig(**sv.get("soak", {}))
             clock = FakeClock()
-            if "batching" in params_json:
-                # continuous-batching path: the front routes every admitted
-                # request through ONE paged batcher event loop instead of
-                # serial per-request generate calls (REPRODUCING §13)
-                from .serve.batching import BatchingConfig, ContinuousBatcher
-                from .serve.frontend import Request
-
-                bcfg = BatchingConfig(**params_json["batching"])
-                batcher = ContinuousBatcher(cfg, params, bcfg)
-                front = ServeFront(cfg, params, config=front_cfg,
-                                   clock=clock, batcher=batcher)
-                # warm the ragged step + the soak's prefill shape so compile
-                # time never lands on a request's service clock
-                warm = ContinuousBatcher(cfg, params, bcfg)
-                warm.submit(np.ones((soak.prompt_len,), np.int32), 2)
-                warm.run()
-                rng = np.random.default_rng(soak.seed)
-                gaps = rng.exponential(1.0 / soak.arrival_rate,
-                                       size=soak.n_requests)
-                for i in range(soak.n_requests):
-                    clock.advance(float(gaps[i]))
-                    front.submit(Request(
-                        prompt_ids=rng.integers(
-                            1, cfg.vocab_size,
-                            size=soak.prompt_len).astype(np.int32),
-                        max_new_tokens=soak.max_new_tokens,
-                        temperature=soak.temperature,
-                        deadline_s=soak.deadline_s, rng_seed=i))
-                records = front.drain_batched()
-                rep = batcher.report()
-                outcomes: dict = {}
-                for rec in records:
-                    outcomes[rec.outcome] = outcomes.get(rec.outcome, 0) + 1
-                artifact = {"requests": len(records), "outcomes": outcomes,
-                            "batcher": rep,
-                            "records": [r.as_dict() for r in records]}
-                with open(out("serve_report.json"), "w") as f:
-                    json.dump(artifact, f, indent=1, default=float)
-                print(json.dumps({
-                    "requests": len(records), "outcomes": outcomes,
-                    "batched_steps": rep["steps"],
-                    "jit_misses": rep["jit_misses"],
-                    "occupancy_mean": round(rep["alloc_util_mean"], 4),
-                    "decode_tokens_per_s": round(
-                        rep["decode_tokens_per_s"], 3),
-                    "artifact": out("serve_report.json")}))
-                if args.serve_report:
-                    _print_serve_report(front.report())
-                return 0
             rt = None
             link_health = None
             if "cuts" in params_json:
@@ -759,9 +738,72 @@ def main(argv=None) -> int:
                     link_health = LinkHealth(
                         config=LinkHealthConfig(**params_json["link_health"]),
                         clock=clock)
+            if "batching" in params_json:
+                # continuous-batching path: the front routes every admitted
+                # request through ONE paged batcher event loop instead of
+                # serial per-request generate calls (REPRODUCING §13); with
+                # "cuts" the ragged step runs through the split pipeline's
+                # quantized boundary hops (SplitRuntime.decode_step_paged)
+                from .serve.batching import BatchingConfig, ContinuousBatcher
+                from .serve.frontend import Request
+
+                bcfg = BatchingConfig(**params_json["batching"])
+                split_kw = {}
+                if rt is not None:
+                    split_kw = dict(split_runtime=rt,
+                                    placed_params=rt.place_params(params))
+                batcher = ContinuousBatcher(cfg, params, bcfg, **split_kw)
+                front = ServeFront(cfg, params, config=front_cfg,
+                                   clock=clock, batcher=batcher)
+                # warm the ragged step + the soak's prefill shape so compile
+                # time never lands on a request's service clock
+                warm = ContinuousBatcher(cfg, params, bcfg, **split_kw)
+                warm.submit(np.ones((soak.prompt_len,), np.int32), 2)
+                warm.run()
+                rng = np.random.default_rng(soak.seed)
+                gaps = rng.exponential(1.0 / soak.arrival_rate,
+                                       size=soak.n_requests)
+                for i in range(soak.n_requests):
+                    clock.advance(float(gaps[i]))
+                    front.submit(Request(
+                        prompt_ids=rng.integers(
+                            1, cfg.vocab_size,
+                            size=soak.prompt_len).astype(np.int32),
+                        max_new_tokens=soak.max_new_tokens,
+                        temperature=soak.temperature,
+                        deadline_s=soak.deadline_s, rng_seed=i))
+                records = front.drain_batched()
+                rep = batcher.report()
+                outcomes: dict = {}
+                for rec in records:
+                    outcomes[rec.outcome] = outcomes.get(rec.outcome, 0) + 1
+                artifact = {"requests": len(records), "outcomes": outcomes,
+                            "mode": ("batched_split" if rt is not None
+                                     else "batched"),
+                            "batcher": rep,
+                            "records": [r.as_dict() for r in records]}
+                with open(out("serve_report.json"), "w") as f:
+                    json.dump(artifact, f, indent=1, default=float)
+                print(json.dumps({
+                    "requests": len(records), "outcomes": outcomes,
+                    "mode": artifact["mode"],
+                    "batched_steps": rep["steps"],
+                    "jit_misses": rep["jit_misses"],
+                    "occupancy_mean": round(rep["alloc_util_mean"], 4),
+                    "decode_tokens_per_s": round(
+                        rep["decode_tokens_per_s"], 3),
+                    "artifact": out("serve_report.json")}))
+                if args.serve_report:
+                    _print_serve_report(front.report())
+                return 0
+            spec = None
+            if "speculative" in params_json:
+                from .serve.speculative import SpecConfig
+
+                spec = SpecConfig(**params_json["speculative"])
             front = ServeFront(cfg, params, split_runtime=rt,
                                config=front_cfg, link_health=link_health,
-                               clock=clock)
+                               clock=clock, speculative=spec)
             # pre-warm the jit caches for the soak's one (batch, capacity)
             # plan: the virtual clock advances by measured service time, and
             # folding tens of compile-seconds into the first request would
@@ -773,8 +815,14 @@ def main(argv=None) -> int:
                            rng_key=jax.random.key(0))
             generate(cfg, params, warm_ids, soak.max_new_tokens, **warm_kw)
             if rt is not None:
+                if spec is not None and spec.enabled:
+                    # the front bumps capacity the same way for spec bursts
+                    warm_kw["capacity"] = max(
+                        capacity, soak.prompt_len + soak.max_new_tokens
+                        + spec.k - 2)
                 generate_split(rt, rt.place_params(params), warm_ids,
-                               soak.max_new_tokens, **warm_kw)
+                               soak.max_new_tokens, speculative=spec,
+                               raw_params=params, **warm_kw)
             artifact = run_soak(front, soak, clock=clock)
             with open(out("serve_report.json"), "w") as f:
                 json.dump(artifact, f, indent=1, default=float)

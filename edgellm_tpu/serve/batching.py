@@ -32,7 +32,14 @@ module schedules many streams through ONE jitted decode step built on
   streams from disk; a per-step
   :class:`~edgellm_tpu.serve.recovery.Watchdog` guards wedged steps with the
   same typed :class:`~edgellm_tpu.serve.recovery.DecodeTimeout` the serving
-  front already handles.
+  front already handles;
+- passing ``split_runtime=``/``placed_params=`` drives the SAME scheduler
+  through ``SplitRuntime.decode_step_paged`` instead of the local pool: the
+  host-side :class:`~edgellm_tpu.models.paged_kv.PagedKVCache` runs in
+  bookkeeping-only mode (``materialize=False``), the K/V pages live
+  per-stage on the mesh (``SplitRuntime.init_paged_pool``), and every ragged
+  step crosses the boundary once per cut through the quantized hop ladder —
+  batched serving over a split plan, no longer local-pool-only.
 
 ``ServeFront`` integration lives in ``serve/frontend.py`` (``batcher=``):
 admission control, brownout and breakers all apply before a request reaches
@@ -159,6 +166,12 @@ def batched_step_cache_size() -> int:
     return _batched_step_jit._cache_size()
 
 
+# the split step returns (max_slots, V) logits from decode_step_paged; the
+# sampler is the SAME vmapped _batched_sample, jitted standalone so split
+# streams keep the local path's per-slot bit-identity guarantee
+_split_sample_jit = jax.jit(_batched_sample)
+
+
 class ContinuousBatcher:
     """Admit/evict streams mid-flight into one compiled ragged decode step.
 
@@ -172,15 +185,36 @@ class ContinuousBatcher:
     """
 
     def __init__(self, cfg: ModelConfig, params: dict,
-                 bcfg: Optional[BatchingConfig] = None):
+                 bcfg: Optional[BatchingConfig] = None, *,
+                 split_runtime: Any = None, placed_params: Any = None):
         self.cfg = cfg
         self.params = params
         self.bcfg = bcfg if bcfg is not None else BatchingConfig()
+        self.rt = split_runtime
+        if split_runtime is not None:
+            if placed_params is None:
+                raise ValueError(
+                    "split_runtime needs placed_params (the SplitRuntime's "
+                    "placed parameter tree)")
+            if self.bcfg.compute_dtype is not None:
+                raise ValueError(
+                    "compute_dtype is a local-pool knob; the split runtime "
+                    "owns its own dtypes — leave it None")
+        self.placed = placed_params
+        # split mode: the host PagedKVCache is the ALLOCATOR only (page
+        # table, lengths, free list); the actual K/V pages live per-stage on
+        # the mesh and move through the runtime's paged scatter/gather
         self.pool = PagedKVCache(
             cfg, num_pages=self.bcfg.num_pages,
             page_size=self.bcfg.page_size, max_slots=self.bcfg.max_slots,
             pages_per_slot=self.bcfg.pages_per_slot,
-            dtype=self.bcfg.cache_dtype)
+            dtype=self.bcfg.cache_dtype,
+            materialize=split_runtime is None)
+        self._split_pool = (
+            split_runtime.init_paged_pool(self.bcfg.num_pages,
+                                          self.bcfg.page_size,
+                                          dtype=self.bcfg.cache_dtype)
+            if split_runtime is not None else None)
         self._streams: dict[int, Stream] = {}
         self._waiting: deque[int] = deque()
         self._slot_to_sid: dict[int, int] = {}
@@ -271,19 +305,43 @@ class ContinuousBatcher:
             return False
         t0 = time.monotonic()
         if st.resume is not None:
-            self.pool.adopt(slot, jnp.asarray(st.resume["k"]),
-                            jnp.asarray(st.resume["v"]), need_len)
+            if self.rt is not None:
+                self.pool.ensure(slot, need_len)
+                dest = self.pool._flat_indices(slot, need_len)
+                self._split_pool = self.rt.adopt_paged_rows(
+                    self._split_pool, st.resume["k"], st.resume["v"], dest)
+                self.pool.lengths[slot] = need_len
+            else:
+                self.pool.adopt(slot, jnp.asarray(st.resume["k"]),
+                                jnp.asarray(st.resume["v"]), need_len)
             st.resume = None
         else:
-            # the exact generate() prefill: same executable, same capacity
-            # semantics (KV values are capacity-invariant), same token-0 key
-            last_logits, cache = _prefill_jit(
-                self.cfg, self.params, jnp.asarray(st.prompt[None, :]),
-                self.bcfg.span, self.bcfg.compute_dtype)
-            tok0 = _sample(last_logits, jax.random.fold_in(st.key, 0),
-                           st.temperature)
             s = st.prompt.size
-            self.pool.adopt(slot, cache.k[:, 0, :s], cache.v[:, 0, :s], s)
+            if self.rt is not None:
+                # the exact generate_split() prefill: same executable, same
+                # token-0 key, then the per-stage cache rows scatter into the
+                # mesh pools at this slot's pages
+                logits, cache = self.rt.prefill_decode(
+                    self.placed, jnp.asarray(st.prompt[None, :]),
+                    self.bcfg.span)
+                tok0 = _sample(logits[:, -1], jax.random.fold_in(st.key, 0),
+                               st.temperature)
+                self.pool.ensure(slot, s)
+                dest = self.pool._flat_indices(slot, s)
+                self._split_pool = self.rt.adopt_paged(
+                    self._split_pool, cache, 0, dest, s)
+                self.pool.lengths[slot] = s
+            else:
+                # the exact generate() prefill: same executable, same
+                # capacity semantics (KV values are capacity-invariant),
+                # same token-0 key
+                last_logits, cache = _prefill_jit(
+                    self.cfg, self.params, jnp.asarray(st.prompt[None, :]),
+                    self.bcfg.span, self.bcfg.compute_dtype)
+                tok0 = _sample(last_logits, jax.random.fold_in(st.key, 0),
+                               st.temperature)
+                self.pool.adopt(slot, cache.k[:, 0, :s], cache.v[:, 0, :s],
+                                s)
             st.tokens.append(int(np.asarray(tok0)[0]))
         self.stats["prefill_s"] += time.monotonic() - t0
         st.status, st.slot = "running", slot
@@ -295,6 +353,20 @@ class ContinuousBatcher:
             self._finish(st)
         return True
 
+    def _gather_state(self, slot: int) -> dict:
+        """One slot's contiguous K/V prefix as the resume/checkpoint payload.
+        Local pool: ``gather_slot``'s (L, n, KV, hd) dict. Split: the
+        per-stage (n_stages, sz, n, KV, hd) twin from ``gather_paged`` —
+        byte-identical to the rows ``adopt_paged`` scattered, so re-admission
+        through ``adopt_paged_rows`` resumes token-identically."""
+        if self.rt is None:
+            return self.pool.gather_slot(slot)
+        n = int(self.pool.lengths[slot])
+        idx = self.pool._flat_indices(slot, max(n, 1))
+        k_seq, v_seq = self.rt.gather_paged(self._split_pool, idx)
+        return {"k": k_seq[:, :, :n], "v": v_seq[:, :, :n],
+                "length": np.asarray(n, np.int32)}
+
     def evict(self, sid: int) -> None:
         """Push a running stream back to the waiting queue, gathering its
         pages to a contiguous prefix (byte-identical to a contiguous cache,
@@ -303,7 +375,7 @@ class ContinuousBatcher:
         st = self._streams[sid]
         if st.status != "running":
             raise ValueError(f"stream {sid} is not running")
-        st.resume = self.pool.gather_slot(st.slot)
+        st.resume = self._gather_state(st.slot)
         self.pool.free_slot(st.slot)
         del self._slot_to_sid[st.slot]
         st.status, st.slot = "waiting", -1
@@ -338,6 +410,16 @@ class ContinuousBatcher:
 
     def _running(self) -> list[Stream]:
         return [self._streams[sid] for sid in self._slot_to_sid.values()]
+
+    def _step_cache_size(self) -> int:
+        """Executables behind this batcher's ragged step — local: the fused
+        step+sample jit; split: the runtime's per-geometry paged step plus
+        the standalone sampler. Deltas across a step are the jit misses."""
+        if self.rt is not None:
+            step_fn = self.rt._paged_decode_fns(self.bcfg.num_pages,
+                                                self.bcfg.page_size)
+            return step_fn._cache_size() + _split_sample_jit._cache_size()
+        return batched_step_cache_size()
 
     def step(self) -> int:
         """Admit what fits, run ONE compiled ragged step over every running
@@ -387,17 +469,27 @@ class ContinuousBatcher:
         # i's cache holds prompt + t-1 fed tokens (== pool lengths by
         # construction); inactive slots write the trash page
         page_table, lengths = self.pool.device_tables()
-        misses0 = batched_step_cache_size()
+        misses0 = self._step_cache_size()
         t0 = time.monotonic()
-        toks, k, v = _batched_step_jit(
-            self.cfg, self.params, self.pool.pool.k, self.pool.pool.v,
-            page_table, lengths, jnp.asarray(token_ids),
-            jnp.stack(keys), jnp.asarray(steps), jnp.asarray(temps),
-            self.bcfg.compute_dtype)
-        self.pool.pool = type(self.pool.pool)(k, v)
+        if self.rt is not None:
+            # one ragged split step: every cut hops ONE (max_slots, 1, D)
+            # quantized activation block, the sampler is the same vmapped
+            # _batched_sample the local step fuses in
+            logits, self._split_pool = self.rt.decode_step_paged(
+                self.placed, self._split_pool, page_table, lengths,
+                jnp.asarray(token_ids))
+            toks = _split_sample_jit(logits, jnp.stack(keys),
+                                     jnp.asarray(steps), jnp.asarray(temps))
+        else:
+            toks, k, v = _batched_step_jit(
+                self.cfg, self.params, self.pool.pool.k, self.pool.pool.v,
+                page_table, lengths, jnp.asarray(token_ids),
+                jnp.stack(keys), jnp.asarray(steps), jnp.asarray(temps),
+                self.bcfg.compute_dtype)
+            self.pool.pool = type(self.pool.pool)(k, v)
         toks_host = np.asarray(toks)  # ONE host sync per step
         self.stats["decode_s"] += time.monotonic() - t0
-        self.stats["jit_misses"] += batched_step_cache_size() - misses0
+        self.stats["jit_misses"] += self._step_cache_size() - misses0
         self.stats["steps"] += 1
 
         advanced = 0
@@ -445,7 +537,7 @@ class ContinuousBatcher:
         prefix, not pages)."""
         st = self._streams[sid]
         if st.status == "running":
-            state = self.pool.gather_slot(st.slot)
+            state = self._gather_state(st.slot)
         elif st.resume is not None:
             state = st.resume
         else:
@@ -455,12 +547,20 @@ class ContinuousBatcher:
                   "cache/length": state["length"],
                   "prompt_ids": st.prompt[None, :].astype(np.int32),
                   "tokens": np.asarray(st.tokens, np.int32)[None, :]}
-        meta = {"mode": "paged", "model": _model_sig(self.cfg),
+        meta = {"mode": self._ckpt_mode(), "model": _model_sig(self.cfg),
                 "sid": int(sid),
                 "step": int(st.t - 1), "rng_seed": int(st.rng_seed),
                 "temperature": float(st.temperature),
                 "max_new_tokens": int(st.max_new_tokens)}
+        if self.rt is not None:
+            # split payloads are per-stage rows — refuse restore onto a
+            # different placement the same way recovery checkpoints do
+            meta["cuts"] = [int(c) for c in self.rt.split.cuts]
+            meta["hop_codecs"] = [c.name for c in self.rt.codecs]
         return DecodeCheckpoint(arrays, meta).save(path)
+
+    def _ckpt_mode(self) -> str:
+        return "paged" if self.rt is None else "paged_split"
 
     def restore_stream(self, path: str) -> int:
         """Re-queue a checkpointed stream; its remaining tokens come out
@@ -468,14 +568,22 @@ class ContinuousBatcher:
         the seed and the step index, the KV prefix is restored bit-exactly)."""
         ckpt = DecodeCheckpoint.load(path)
         meta = ckpt.meta
-        if meta.get("mode") != "paged":
+        if meta.get("mode") != self._ckpt_mode():
             raise CheckpointError(
-                f"{path} is a {meta.get('mode')!r} checkpoint, not a paged "
-                f"stream snapshot")
+                f"{path} is a {meta.get('mode')!r} checkpoint, this batcher "
+                f"restores {self._ckpt_mode()!r} stream snapshots")
         if meta.get("model") != _model_sig(self.cfg):
             raise CheckpointError(
                 f"{path} was written for model {meta.get('model')!r}, this "
                 f"batcher runs {_model_sig(self.cfg)!r}")
+        if self.rt is not None:
+            want = {"cuts": [int(c) for c in self.rt.split.cuts],
+                    "hop_codecs": [c.name for c in self.rt.codecs]}
+            for k, v in want.items():
+                if meta.get(k) != v:
+                    raise CheckpointError(
+                        f"{path} {k}={meta.get(k)!r} does not match this "
+                        f"runtime's {k}={v!r}")
         sid = self.submit(ckpt.arrays["prompt_ids"][0],
                           int(meta["max_new_tokens"]),
                           temperature=float(meta["temperature"]),

@@ -46,7 +46,8 @@ from ..models.transformer import (KVCache, cache_from_state_dict,
 from ..obs.latency import LatencyObserver
 from ..obs.metrics import (CounterSource, get_registry, record_decode_stats,
                            record_link_counters, record_link_health,
-                           record_recovery_counters, record_wire_bytes)
+                           record_probe_decisions, record_recovery_counters,
+                           record_wire_bytes)
 from ..obs.tracing import span as obs_span
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        LocalRuntime, RecoveryConfig, RecoveryCounters,
@@ -209,6 +210,7 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
                    recovery: Optional[RecoveryConfig] = None,
                    raw_params: Optional[dict] = None,
                    link_health: Optional[Any] = None,
+                   speculative: Optional[Any] = None,
                    observe: Optional[LatencyObserver] = None) -> jnp.ndarray:
     """``generate`` over the pipeline-SPLIT decode runtime: one split prefill,
     then O(1) :meth:`SplitRuntime.decode_step` calls, every emitted token
@@ -236,7 +238,24 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
     — the unplaced parameter pytree — to re-place onto the surviving
     devices). ``recovery=None`` is the original loop on the exact same
     runtime executables.
+
+    ``speculative``: an enabled :class:`~edgellm_tpu.serve.speculative.
+    SpecConfig` routes the call through the draft/verify burst loop (greedy
+    output token-identical, one boundary hop round per burst instead of per
+    token; needs ``raw_params`` for the stage-0 draft). ``None`` — or a
+    disabled config — is PURE host-side dispatch: the loop below runs
+    unchanged and builds the exact pre-spec graphs (the graphlint identity
+    contract holds because this branch never touches the verify executable).
     """
+    if speculative is not None and getattr(speculative, "enabled", False):
+        # lazy import: speculative imports this module's helpers
+        from .speculative import generate_speculative
+
+        return generate_speculative(
+            rt, placed_params, prompt_ids, max_new_tokens, spec=speculative,
+            capacity=capacity, temperature=temperature, rng_key=rng_key,
+            fault_step=fault_step, stats=stats, recovery=recovery,
+            raw_params=raw_params, link_health=link_health, observe=observe)
     prompt_ids, capacity, temperature, key = _validate_decode_args(
         prompt_ids, max_new_tokens, capacity, temperature, rng_key)
     b, s = prompt_ids.shape
@@ -285,6 +304,8 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
     if get_registry().enabled and isinstance(rt, CounterSource):
         record_wire_bytes(rt.decode_hop_bytes(b), kind="decode",
                           steps=max_new_tokens - 1)
+        if hasattr(rt, "wire_summary"):
+            record_probe_decisions(rt.wire_summary(b, max(s, 1)))
     if stats is not None:
         steps = max_new_tokens - 1
         stats.update(
@@ -543,10 +564,17 @@ def resume_split(rt: Any, placed_params: dict, checkpoint_path: str, *,
                  stats: Optional[dict] = None,
                  recovery: Optional[RecoveryConfig] = None,
                  raw_params: Optional[dict] = None,
+                 speculative: Optional[Any] = None,
                  observe: Optional[LatencyObserver] = None) -> jnp.ndarray:
     """Resume a checkpointed generation and return the FULL (B, max_new)
     token matrix — the checkpointed prefix plus the tokens decoded here,
     token-identical to the uninterrupted same-seed run.
+
+    ``speculative``: an enabled SpecConfig resumes through the burst loop
+    (:func:`~edgellm_tpu.serve.speculative.resume_speculative` — spec
+    checkpoints land on burst boundaries, so the resumed stream matches the
+    uninterrupted speculative run token for token); ``None``/disabled is the
+    vanilla resume below, untouched.
 
     ``rt``/``placed_params`` must match the checkpoint's plan and model
     signature (validated; a mismatch is a typed :class:`CheckpointError` —
@@ -556,6 +584,13 @@ def resume_split(rt: Any, placed_params: dict, checkpoint_path: str, *,
     decode-step indices, comparable to the checkpoint's ``step``. Works for
     both split runtimes and :class:`LocalRuntime` (unsplit ``generate``
     checkpoints)."""
+    if speculative is not None and getattr(speculative, "enabled", False):
+        from .speculative import resume_speculative
+
+        return resume_speculative(
+            rt, placed_params, checkpoint_path, spec=speculative,
+            stats=stats, recovery=recovery, raw_params=raw_params,
+            observe=observe)
     with obs_span("decode.checkpoint_resume", path=checkpoint_path):
         ckpt = DecodeCheckpoint.load(checkpoint_path)
     meta = ckpt.meta

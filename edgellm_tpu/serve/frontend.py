@@ -226,6 +226,7 @@ class ServeFront:
                  link_health: Any = None,
                  compute_dtype: Any = None,
                  batcher: Any = None,
+                 speculative: Any = None,
                  clock: Clock = MONOTONIC):
         if split_runtime is not None and split_ladder is not None:
             raise ServeFrontConfigError(
@@ -236,6 +237,10 @@ class ServeFront:
         self.clock = clock
         self.compute_dtype = compute_dtype
         self.link_health = link_health
+        # SpecConfig for the split backend: every split-served request runs
+        # speculative decode (draft + one k-token verify hop per burst);
+        # None / disabled leaves generate_split on its vanilla loop
+        self.speculative = speculative
         self._params = params
         self.admission = AdmissionController(self.config.admission)
         self.budget = RetryBudget(self.config.retry_budget, clock=clock)
@@ -386,7 +391,10 @@ class ServeFront:
         tokens are bit-identical to its solo ``generate`` run (the batcher's
         core invariant, asserted by ``tests/test_batching.py``). Requests
         with batch > 1 prompts fall back to the one-shot path — the batcher
-        serves single streams."""
+        serves single streams. A split-driven batcher (built with
+        ``split_runtime=``) serves the same way through
+        ``SplitRuntime.decode_step_paged`` — records carry
+        ``plan["mode"] == "batched_split"`` plus the cuts/codecs."""
         if self.batcher is None:
             raise ServeFrontConfigError(
                 "drain_batched needs a continuous batcher: "
@@ -447,6 +455,12 @@ class ServeFront:
                 "page_size": self.batcher.bcfg.page_size,
                 "num_pages": self.batcher.bcfg.num_pages,
                 "max_slots": self.batcher.bcfg.max_slots}
+        if getattr(self.batcher, "rt", None) is not None:
+            # split-driven batcher: every ragged step crossed the boundary
+            # through the quantized hop ladder — record the plan it ran on
+            plan["mode"] = "batched_split"
+            plan["cuts"] = [int(c) for c in self.batcher.rt.split.cuts]
+            plan["hop_codecs"] = [c.name for c in self.batcher.rt.codecs]
         for sid in sorted(inflight):
             pend, wait, started = inflight[sid]
             b, s = pend.prompt.shape
@@ -607,11 +621,18 @@ class ServeFront:
         key = jax.random.key(p.req.rng_seed)
         rec = self._recovery_cfg(p.rid)
         if backend == "split":
+            if getattr(self.speculative, "enabled", False):
+                # a verify burst may write k-1 draft rows past the vanilla
+                # high-water mark; same deterministic formula per request
+                # shape, so plan warming still holds
+                capacity = max(capacity, p.prompt.shape[1] + p.granted
+                               + self.speculative.k - 2)
             toks = generate_split(
                 self._rt, self._placed, p.prompt, p.granted,
                 capacity=capacity, temperature=p.req.temperature,
                 rng_key=key, fault_step=p.rid, stats=stats, recovery=rec,
-                raw_params=self._params, link_health=self.link_health)
+                raw_params=self._params, link_health=self.link_health,
+                speculative=self.speculative)
         else:
             toks = generate(
                 self.model_cfg, self._params, p.prompt, p.granted,

@@ -133,10 +133,23 @@ def _verify_completed(front: ServeFront, records: list, submitted: dict,
                            compute_dtype=front.compute_dtype)
         else:
             clean, placed = runner
+            # the replay must run the same decode algorithm the front did:
+            # a speculative front samples through residual resampling, whose
+            # stream matches vanilla sampling only at temperature 0 (spec-vs-
+            # vanilla parity is pinned separately, in tests/test_speculative).
+            # The capacity bump mirrors ServeFront._run — the record keeps the
+            # pre-bump bucketed value.
+            spec = getattr(front, "speculative", None)
+            spec_kw: dict = {}
+            cap = r.capacity
+            if getattr(spec, "enabled", False):
+                spec_kw = {"speculative": spec, "raw_params": front.params}
+                cap = max(cap, prompt.shape[1] + r.granted_tokens
+                          + spec.k - 2)
             ref = generate_split(clean, placed, prompt, r.granted_tokens,
-                                 capacity=r.capacity,
+                                 capacity=cap,
                                  temperature=temperature, rng_key=rng,
-                                 fault_step=r.request_id)
+                                 fault_step=r.request_id, **spec_kw)
         checked += 1
         if np.array_equal(np.asarray(ref), r.tokens):
             matched += 1
