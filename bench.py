@@ -136,6 +136,16 @@ default 4), BENCH_SPEC_KS (default "1,2,4,8"), BENCH_SPEC_CODEC,
 BENCH_SPEC_DRAFT_LAYERS, plus the shared BENCH_MODEL / BENCH_DTYPE /
 BENCH_REPEATS.
 
+BENCH_PIPE=1 switches to the micro-batch pipelined split-decode workload
+(see ``pipe_main``): the sequential vs pipelined schedule over the same
+quantized boundary at n_stages in BENCH_PIPE_STAGES (default "2,3,4"),
+asserting greedy token parity ALWAYS and, when timed (real accelerator or
+BENCH_PIPE_TIME=1), reporting tokens/s and the measured steady-state
+pipeline-bubble fraction per stage count. Knobs: BENCH_PIPE_STAGES,
+BENCH_PIPE_MICRO (default 4), BENCH_PIPE_PROMPT (default 16),
+BENCH_PIPE_TOKENS (default 32), BENCH_PIPE_CODEC, BENCH_PIPE_BATCH, plus
+the shared BENCH_MODEL / BENCH_DTYPE / BENCH_REPEATS.
+
 Every artifact (headline sidecar) carries a ``meta`` provenance block —
 schema_version, git commit, jax/jaxlib versions, backend, UTC timestamp —
 attached centrally in ``_emit``; readers must tolerate its absence in
@@ -898,6 +908,155 @@ def spec_main():
     _emit(line, detail)
 
 
+def pipe_main():
+    """BENCH_PIPE=1: micro-batch pipelined split decode vs the sequential
+    schedule at n_stages in BENCH_PIPE_STAGES (default "2,3,4").
+
+    For every stage count with enough devices: build the SAME boundary twice
+    — once sequential, once with ``PipelineConfig(BENCH_PIPE_MICRO)`` µ-batches
+    — run greedy ``generate_split`` through both, and ALWAYS assert token
+    parity (the schedule is a latency optimization, never a numerics change).
+    When the backend is a real accelerator (or BENCH_PIPE_TIME=1 forces it)
+    the legs are timed and the row carries the measured steady-state bubble
+    fraction, 1 - t_seq / (n_stages * t_pipe): 0 is a perfectly full
+    pipeline, (n_stages-1)/n_stages means the schedule bought nothing over
+    sequential. Off-accelerator the rows carry ``timing_skipped`` (every
+    spoofed CPU "stage" shares one physical core, so overlap is
+    unmeasurable) but still record parity and the analytic schedule bubble
+    (n_stages-1)/(M+n_stages-1). Knobs: BENCH_PIPE_STAGES, BENCH_PIPE_MICRO
+    (default 4), BENCH_PIPE_PROMPT (default 16), BENCH_PIPE_TOKENS (default
+    32), BENCH_PIPE_CODEC (default int8_per_token), BENCH_PIPE_BATCH
+    (default max(4, µ-batches)), plus the shared BENCH_MODEL / BENCH_DTYPE /
+    BENCH_REPEATS. Needs >= 2 devices."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.obs.metrics import get_registry, record_pipeline_stats
+    from edgellm_tpu.serve.decode import generate_split
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    prompt = int(os.environ.get("BENCH_PIPE_PROMPT", "16"))
+    new_tokens = int(os.environ.get("BENCH_PIPE_TOKENS", "32"))
+    micro = int(os.environ.get("BENCH_PIPE_MICRO", "4"))
+    codec = os.environ.get("BENCH_PIPE_CODEC", "int8_per_token")
+    batch = int(os.environ.get("BENCH_PIPE_BATCH", str(max(4, micro))))
+    stage_counts = sorted({int(x) for x in os.environ.get(
+        "BENCH_PIPE_STAGES", "2,3,4").split(",")})
+    repeats = max(int(os.environ.get("BENCH_REPEATS", "2")), 1)
+    if batch % micro:
+        batch += micro - batch % micro  # round up to a whole µ-batch grid
+    n_dev = len(jax.devices())
+    timed = (jax.default_backend() != "cpu"
+             or os.environ.get("BENCH_PIPE_TIME") == "1")
+
+    if n_dev < 2:
+        line = {"metric": f"{model_name} pipelined split decode",
+                "value": None, "unit": None,
+                "vs_baseline": None, "status": "needs_2_devices",
+                "section": "pipe"}
+        _emit(line, {"status": "needs_2_devices", "section": "pipe"})
+        return
+
+    from edgellm_tpu.parallel.split import (PipelineConfig, SplitConfig,
+                                            make_stage_mesh, SplitRuntime)
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, prompt)))
+    capacity = prompt + new_tokens
+
+    def best_tps(rt, placed, kw):
+        generate_split(rt, placed, ids, new_tokens, **kw)  # compile
+        best = None
+        for _ in range(repeats):
+            st: dict = {}
+            toks = np.asarray(generate_split(rt, placed, ids, new_tokens,
+                                             stats=st, **kw))
+            if best is None or st["decode_tokens_per_s"] > best[1]:
+                best = (toks, st["decode_tokens_per_s"])
+        return best
+
+    detail = {"pipe": {"prompt": prompt, "new_tokens": new_tokens,
+                       "batch": batch, "codec": codec,
+                       "num_microbatches": micro, "timed": timed,
+                       "legs": {}}}
+    head = None
+    all_parity = True
+    for n in stage_counts:
+        if n_dev < n:
+            detail["pipe"]["legs"][str(n)] = {
+                "status": f"needs_{n}_devices_found_{n_dev}"}
+            continue
+        # evenly spaced cuts keep per-stage compute (and thus the bubble
+        # accounting) uniform across the pipeline
+        cuts = tuple(round(i * cfg.num_layers / n) for i in range(1, n))
+        split = SplitConfig(cuts=cuts, hop_codecs=(codec,) * (n - 1))
+        mesh = make_stage_mesh(n)
+        rt_seq = SplitRuntime(cfg, split, mesh)
+        rt_pipe = SplitRuntime(cfg, split, mesh,
+                               pipeline=PipelineConfig(num_microbatches=micro))
+        placed = rt_seq.place_params(params)  # codec/schedule-independent
+        kw = dict(capacity=capacity)
+        seq_toks, seq_tps = best_tps(rt_seq, placed, kw)
+        pipe_toks, pipe_tps = best_tps(rt_pipe, placed, kw)
+        parity = bool(np.array_equal(seq_toks, pipe_toks))
+        all_parity &= parity
+        summary = rt_pipe.pipeline_summary()
+        leg = {
+            "cuts": list(cuts), "token_parity": parity,
+            "bubble_fraction_schedule": round(
+                summary["bubble_fraction_schedule"], 4),
+            "bubble_fraction_sequential": round(
+                summary["bubble_fraction_sequential"], 4),
+            "stage_occupancy": [round(o, 4)
+                                for o in summary["stage_occupancy"]],
+        }
+        if timed:
+            # per-token times for the same token count: t_seq/t_pipe
+            # proportionality collapses to a tokens/s ratio
+            measured = 1.0 - pipe_tps / (n * seq_tps)
+            leg.update({
+                "sequential_tokens_per_s": round(seq_tps, 2),
+                "pipelined_tokens_per_s": round(pipe_tps, 2),
+                "speedup_vs_sequential": round(pipe_tps / max(seq_tps, 1e-9),
+                                               4),
+                "bubble_fraction_measured": round(measured, 4),
+                "bubble_below_sequential_bound": bool(
+                    measured < summary["bubble_fraction_sequential"]),
+            })
+            if get_registry().enabled:
+                record_pipeline_stats(
+                    {**summary, "bubble_fraction_measured": measured})
+        else:
+            leg["timing_skipped"] = (
+                f"backend {jax.default_backend()!r}: spoofed stages share "
+                f"one core, pipeline overlap is unmeasurable")
+        detail["pipe"]["legs"][str(n)] = leg
+        head = leg  # the deepest tested pipeline carries the headline
+        if not parity:
+            break  # a numerics break invalidates every deeper leg
+
+    line = {
+        "metric": (f"{model_name} pipelined split decode "
+                   f"(M={micro} µ-batches, {codec} boundary, "
+                   f"n_stages {stage_counts})"),
+        "value": (None if head is None
+                  else head.get("pipelined_tokens_per_s")),
+        "unit": "decode tokens/s",
+        "vs_baseline": None,  # the reference never splits, nothing to pipeline
+        "token_parity": all_parity,
+        "timed": timed,
+        "bubble_fraction_measured": (None if head is None
+                                     else head.get("bubble_fraction_measured")),
+        "bubble_fraction_schedule": (None if head is None
+                                     else head.get("bubble_fraction_schedule")),
+    }
+    _emit(line, detail)
+
+
 def obs_main():
     """BENCH_OBS=1: observability smoke — arm the full obs stack (metrics
     registry + span tracer + latency SLOs), run a short instrumented decode
@@ -1403,6 +1562,8 @@ def main():
         return _run_section("wire", wire_main)
     if os.environ.get("BENCH_SPEC") == "1":
         return _run_section("spec", spec_main)
+    if os.environ.get("BENCH_PIPE") == "1":
+        return _run_section("pipe", pipe_main)
     return _run_section("sweep", sweep_main)
 
 

@@ -127,6 +127,7 @@ def run_split_eval(
     deadline_s: Optional[float] = None,
     stage_failure: Optional[object] = None,
     recovery: Optional[dict] = None,
+    pipeline: Optional[object] = None,
     _clock=MONOTONIC,
 ) -> dict:
     """Token-weighted sliding-window PPL with the model split at ``cuts``.
@@ -250,6 +251,13 @@ def run_split_eval(
     elif mesh is None:
         mesh = make_stage_mesh(split.n_stages)
 
+    if (pipeline is not None and getattr(pipeline, "enabled", False)
+            and n_seq > 1):
+        raise ValueError(
+            "micro-batch pipelining composes with the plain split runtime "
+            "only; the stage x seq ring runtime already overlaps its hops "
+            "with the ring rotation — drop pipeline or set n_seq=1")
+
     def _make_runtime(tier_codecs):
         if n_seq > 1:
             from ..parallel.ring import SplitRingRuntime
@@ -259,7 +267,8 @@ def run_split_eval(
                                     fec=fec, hedge=hedge)
         return SplitRuntime(
             cfg, SplitConfig(cuts=split.cuts, hop_codecs=tuple(tier_codecs)),
-            mesh, faults=faults, policy=link_policy, fec=fec, hedge=hedge)
+            mesh, faults=faults, policy=link_policy, fec=fec, hedge=hedge,
+            pipeline=pipeline)
 
     # tier 0 is the configured codec set; lower tiers swap EVERY hop to one
     # uniform fallback codec (payload shapes change, hence separate runtimes
@@ -269,7 +278,13 @@ def run_split_eval(
     health = None
     if fault_on and policy.tiers:
         for name in policy.tiers:
-            get_wire_codec(name)  # fail fast on a bad ladder entry
+            c = get_wire_codec(name)  # fail fast on a bad ladder entry
+            if (pipeline is not None and getattr(pipeline, "enabled", False)
+                    and not c.batch_invariant and not c.needs_importance):
+                raise ValueError(
+                    f"degradation-ladder tier '{name}' couples batch rows; "
+                    "its wire scales would change under the µ-batch split — "
+                    "use batch-invariant fallback tiers or drop pipeline")
             ladder.append([name] * len(codecs))
     if link_health is not None:
         # the SLO tracker supersedes the streak controller: burn-rate-driven
@@ -304,6 +319,13 @@ def run_split_eval(
     if window_batch % n_data:
         raise ValueError(f"window_batch {window_batch} must be a multiple of the "
                          f"mesh data axis size {n_data}")
+    if getattr(rt, "pipelined", False):
+        # fail before the first chunk, not inside the first traced forward
+        rt.pipeline.validate_batch(window_batch, "window_batch")
+    # a partial tail group pads up to the data axis AND the µ-batch grid
+    # (n_data == 1 whenever pipelined: the runtime enforces a stage-only mesh)
+    group_pad = n_data * (rt.pipeline.num_microbatches
+                          if getattr(rt, "pipelined", False) else 1)
 
     # resume axes: the USER-LEVEL split spec (requested codec specs, not the
     # runtime's possibly Pallas-substituted names, so a checkpoint written on a
@@ -319,6 +341,13 @@ def run_split_eval(
         "window_batch": int(window_batch), "n_seq": int(n_seq),
         "mesh": {k: int(v) for k, v in dict(mesh.shape).items()},
     }
+    if pipeline is not None and getattr(pipeline, "enabled", False):
+        # the µ-batch count changes per-chunk wire traffic and fault-counter
+        # shapes — a plan axis, so resume refuses a mismatched schedule.
+        # Only written when pipelining is ON (axes compare by strict dict
+        # equality, so an unconditional key would orphan pre-pipeline
+        # checkpoints)
+        axes["num_microbatches"] = int(pipeline.num_microbatches)
     if fault_on:
         # a checkpoint written under one fault regime must not silently resume
         # under another (JSON round-trips lists, so tuples are listified here)
@@ -390,9 +419,9 @@ def run_split_eval(
         n_real = len(group)
         s_unpadded = group[0].input_ids.shape[1]
         counts = [c.num_loss_tokens for c in group]
-        # pad a partial group up to the data-axis size with repeated windows;
-        # their loss weight is zero
-        while len(group) % n_data:
+        # pad a partial group up to the data-axis size (and, when pipelined,
+        # the µ-batch grid) with repeated windows; their loss weight is zero
+        while len(group) % group_pad:
             group = group + [group[-1]]
             counts = counts + [0]
         ids = np.concatenate([c.input_ids for c in group])
@@ -582,6 +611,8 @@ def run_split_eval(
                 str(g): list(b) for g, b in gen_bytes.items() if g > 0}
             rec_block["failover_mesh"] = dict(mesh.shape)
         result["recovery"] = rec_block
+    if getattr(rt, "pipelined", False):
+        result["pipeline"] = rt.pipeline_summary()
     if time_hops and rd.chunks:
         t_seq = seq if n_seq <= 1 else seq + (-seq) % n_seq
         # after a failover, time the boundary that actually finished the run
@@ -593,6 +624,17 @@ def run_split_eval(
         if hasattr(timed_rt, "time_decode_hops"):
             with obs_span("eval.time_decode_hops"):
                 result["per_decode_hop_ms"] = timed_rt.time_decode_hops(1)
+        # the flat lists above are positional; label each entry with the
+        # boundary it measures so multi-hop configs (split4 multihop) can
+        # attribute WHICH cut is slow without cross-referencing the config
+        timed_cuts = list(timed_rt.split.cuts)
+        result["per_hop_timing"] = [
+            {"hop": s, "cut_layer": int(timed_cuts[s]),
+             "codec": timed_rt.codecs[s].name,
+             "forward_ms": result["per_hop_ms"][s],
+             **({"decode_ms": result["per_decode_hop_ms"][s]}
+                if "per_decode_hop_ms" in result else {})}
+            for s in range(len(timed_cuts))]
     # mirror this sweep's totals into the global registry (no-ops when
     # observability is off): wire bytes, fault/health/recovery counters,
     # and the per-hop fused-probe decisions (why a hop did/didn't fuse)

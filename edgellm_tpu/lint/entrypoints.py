@@ -64,7 +64,8 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     from ..codecs.faults import COUNTER_KEYS, FaultConfig, LinkPolicy
     from ..models import transformer
     from ..models.configs import tiny_config
-    from ..parallel.split import SplitConfig, SplitRuntime, make_stage_mesh
+    from ..parallel.split import (PipelineConfig, SplitConfig, SplitRuntime,
+                                  make_stage_mesh)
     from ..serve import decode as serve_decode
     from ..serve import recovery
 
@@ -301,6 +302,75 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
         what="spec-aware build's vanilla decode-step graph")
     (findings.extend(ident) if ident
      else checked.append("split.decode_step.spec-disabled-identity"))
+
+    # ---- micro-batch pipelined schedule: same wire protocol per hop, but
+    # ---- every cut now moves M payloads of (B/M, ...) — hop_eqns and wire
+    # ---- bytes scale by M, replication still collapses to ONE stacked psum,
+    # ---- and the KV/pool donation discipline survives the schedule --------
+    PBATCH, PM = 2, 2  # batch and µ-batch count; µ-batch rows = PBATCH // PM
+    rt_pipe = SplitRuntime(cfg, split, mesh,
+                           pipeline=PipelineConfig(num_microbatches=PM))
+    pipe_ids = jnp.zeros((PBATCH, SEQ), jnp.int32)
+    pipe_fwd_ctx = {
+        "hop_eqns": PM * n_hops * leaves_f,
+        "wire_dtypes": frozenset(dtypes_f),
+        "wire_bytes": PM * sum(rt_pipe.hop_bytes(PBATCH // PM, SEQ)),
+    }
+    run_one("split.forward.pipelined", rt_pipe._forward,
+            (placed, pipe_ids, imps), pipe_fwd_ctx)
+
+    pipe_kv_shape = (split.n_stages, rt.stage_size, PBATCH, CAPACITY,
+                     cfg.num_kv_heads, cfg.head_dim)
+    pipe_k = jnp.zeros(pipe_kv_shape, jnp.float32)
+    pipe_v = jnp.zeros(pipe_kv_shape, jnp.float32)
+    pipe_tok = jnp.zeros((PBATCH,), jnp.int32)
+    _, pipe_step_fn = rt_pipe._decode_fns(CAPACITY)
+    pipe_step_ctx = {
+        "hop_eqns": PM * n_hops * leaves_s,
+        "wire_dtypes": frozenset(dtypes_s),
+        "wire_bytes": sum(rt_pipe.pipelined_decode_hop_bytes(PBATCH)),
+        "donate_min": 2,
+    }
+    run_one("split.decode_step.pipelined", pipe_step_fn,
+            (placed, pipe_k, pipe_v, length, pipe_tok), pipe_step_ctx,
+            lowerable=pipe_step_fn,
+            lower_args=(placed, pipe_k, pipe_v, length, pipe_tok))
+
+    # MS slots split into PM µ-batches of MS // PM ragged rows each
+    pipe_pstep_fn = rt_pipe._paged_decode_fns(NPG, PGS)
+    pipe_paged_ctx = {
+        "hop_eqns": PM * n_hops * leaves_p,
+        "wire_dtypes": frozenset(dtypes_p),
+        "wire_bytes": sum(rt_pipe.pipelined_decode_hop_bytes(MS)),
+        "donate_min": 2,
+    }
+    run_one("split.decode_step_paged.pipelined", pipe_pstep_fn,
+            (placed, spool["k"], spool["v"], ptab, plens, ptoks),
+            pipe_paged_ctx,
+            lowerable=pipe_pstep_fn,
+            lower_args=(placed, spool["k"], spool["v"], ptab, plens, ptoks))
+
+    # num_microbatches=1 must trace the ORIGINAL sequential schedule byte for
+    # byte — the fingerprint half of the ISSUE's disabled-pipeline contract
+    # (run.py's validator and the runtime's n_micro dispatch are the other
+    # half); pinned for forward AND decode so neither schedule can drift
+    rt_m1 = SplitRuntime(cfg, split, mesh,
+                         pipeline=PipelineConfig(num_microbatches=1))
+    ident = check_identity(
+        "split.forward.pipeline-disabled-identity",
+        rt._forward, (placed, ids, imps),
+        rt_m1._forward, (placed, ids, imps),
+        what="num_microbatches=1 build's forward graph")
+    (findings.extend(ident) if ident
+     else checked.append("split.forward.pipeline-disabled-identity"))
+    _, step_fn_m1 = rt_m1._decode_fns(CAPACITY)
+    ident = check_identity(
+        "split.decode_step.pipeline-disabled-identity",
+        step_fn, (placed, k_cache, v_cache, length, tok),
+        step_fn_m1, (placed, k_cache, v_cache, length, tok),
+        what="num_microbatches=1 build's decode-step graph")
+    (findings.extend(ident) if ident
+     else checked.append("split.decode_step.pipeline-disabled-identity"))
 
     # ---- faulty link: sealed payloads, statically-unrolled retries ------
     attempts = 2  # 1 try + 1 retry, statically unrolled in the graph

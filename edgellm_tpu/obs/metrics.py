@@ -27,7 +27,10 @@ Metric name catalog (REPRODUCING §10): ``edgellm_link_<counter>_total``
 ``edgellm_decode_token_latency_seconds`` (histograms),
 ``edgellm_spec_{drafted,accepted,rejected,bursts}_total`` /
 ``edgellm_spec_acceptance_rate`` / ``edgellm_spec_hops_per_token``
-(speculative decode), ``edgellm_fused_hop_active`` /
+(speculative decode), ``edgellm_pipeline_microbatches`` /
+``edgellm_pipeline_bubble_fraction[_measured]`` /
+``edgellm_pipeline_stage_occupancy`` (µ-batch pipelined decode, label
+``stage``), ``edgellm_fused_hop_active`` /
 ``edgellm_fused_hop_decision`` / ``edgellm_fused_probe_win`` (fused-hop
 probe decisions, labels ``hop``, ``codec``, ``mode``, ``reason``).
 """
@@ -43,8 +46,9 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Protocol, \
 __all__ = [
     "Counter", "CounterSource", "Gauge", "Histogram", "MetricsRegistry",
     "format_table", "get_registry", "record_decode_stats",
-    "record_link_counters", "record_link_health", "record_probe_decisions",
-    "record_recovery_counters", "record_spec_stats", "record_wire_bytes",
+    "record_link_counters", "record_link_health", "record_pipeline_stats",
+    "record_probe_decisions", "record_recovery_counters", "record_spec_stats",
+    "record_wire_bytes",
 ]
 
 LabelKey = Tuple[Tuple[str, str], ...]
@@ -417,6 +421,33 @@ def record_wire_bytes(per_hop_bytes: Optional[Iterable[float]],
         total = float(b) * int(steps)
         if total:
             c.inc(total, hop=hop, kind=kind)
+
+
+def record_pipeline_stats(summary: Optional[Mapping[str, Any]],
+                          registry: Optional[MetricsRegistry] = None) -> None:
+    """Absorb a :meth:`~edgellm_tpu.parallel.split.SplitRuntime.
+    pipeline_summary` dict as ``edgellm_pipeline_*`` gauges: µ-batch count,
+    per-stage occupancy (label ``stage``), and the analytic schedule bubble
+    fraction — plus ``edgellm_pipeline_bubble_fraction_measured`` when the
+    caller attaches a timed value (BENCH_PIPE does), so bubble regressions
+    surface in scraped metrics, not just bench artifacts."""
+    reg = registry if registry is not None else _REGISTRY
+    if not reg.enabled or not summary:
+        return
+    reg.gauge("edgellm_pipeline_microbatches",
+              "µ-batches per pipelined step (1 = sequential schedule)").set(
+        float(summary.get("num_microbatches", 1)))
+    reg.gauge("edgellm_pipeline_bubble_fraction",
+              "analytic pipeline bubble fraction (n-1)/(M+n-1)").set(
+        float(summary.get("bubble_fraction_schedule", 0.0)))
+    if summary.get("bubble_fraction_measured") is not None:
+        reg.gauge("edgellm_pipeline_bubble_fraction_measured",
+                  "measured steady-state bubble fraction (1 - t_seq/(n*t_pipe))"
+                  ).set(float(summary["bubble_fraction_measured"]))
+    occ = reg.gauge("edgellm_pipeline_stage_occupancy",
+                    "fraction of unroll steps each stage computes")
+    for stage, frac in enumerate(summary.get("stage_occupancy", ())):
+        occ.set(float(frac), stage=stage)
 
 
 def record_spec_stats(stats: Optional[Mapping[str, Any]],

@@ -28,7 +28,7 @@ runtime multiplies the two (O(stages * n_seq) unrolled hops), so a
 4-stage x 8-seq pod layout compiles in the same ballpark as 32 plain stages;
 at the BASELINE configs' 2-3 stages compile cost is negligible.
 """
-from .split import SplitConfig, SplitRuntime, make_stage_mesh
+from .split import PipelineConfig, SplitConfig, SplitRuntime, make_stage_mesh
 from .ring import (ring_attention, forward_sp, make_seq_mesh,
                    SplitRingRuntime, make_sp_stage_mesh)
 from .distributed import (initialize_distributed, build_stage_grid,

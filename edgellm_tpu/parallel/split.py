@@ -223,6 +223,230 @@ def run_pipeline_stages_carry(n_stages: int, codecs: list, run_stage, hidden,
     return out, carry, counters
 
 
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Micro-batch pipelining of the stage unroll (ROADMAP item 4).
+
+    ``num_microbatches`` (M): the batch is split into M contiguous row
+    groups and the stage loop runs a GPipe-style fill/steady/drain schedule
+    of M + n_stages - 1 unroll steps, so in steady state every stage
+    computes a different µ-batch in the same step while the quantized
+    boundary activations of the others are on the wire — instead of one
+    stage computing and n_stages - 1 idling. M == 1 is the disabled
+    configuration: the runtime dispatches to the ORIGINAL sequential
+    unroll, byte-identical to a build that never saw this class (the
+    "split.*.pipeline-disabled-identity" lint pins hold it to that).
+
+    The schedule preserves token identity with the sequential path at any
+    M: each µ-batch flows through exactly the same per-stage math and the
+    same per-cut codec, just interleaved in time. That holds only when
+    codecs treat batch rows independently (``WireCodec.batch_invariant``;
+    scales reduced over the whole batch would change with the µ-batch
+    split), which :class:`SplitRuntime` validates at construction.
+    """
+
+    num_microbatches: int = 1
+
+    def __post_init__(self):
+        if self.num_microbatches < 1:
+            raise ValueError(
+                f"num_microbatches must be >= 1, got {self.num_microbatches}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.num_microbatches > 1
+
+    def validate_batch(self, batch: int, what: str = "batch") -> int:
+        """Check ``batch`` splits evenly into µ-batches; return rows per
+        µ-batch. Every pipelined entry point calls this, so a bad batch
+        fails loudly host-side instead of tracing a ragged schedule."""
+        m = self.num_microbatches
+        if batch < m or batch % m:
+            raise ValueError(
+                f"{what} {batch} must be a positive multiple of "
+                f"num_microbatches={m} (each µ-batch needs >= 1 row)")
+        return batch // m
+
+    def summary(self, n_stages: int) -> dict:
+        """Host-side schedule accounting: unroll length, per-stage
+        occupancy (every stage is busy M of the M + n - 1 steps) and the
+        analytic bubble fraction (n - 1) / (M + n - 1) — the number
+        BENCH_PIPE gates against the sequential (n - 1) / n bound."""
+        m, n = self.num_microbatches, n_stages
+        t = m + n - 1
+        return {
+            "enabled": self.enabled,
+            "num_microbatches": m,
+            "n_stages": n,
+            "unroll_steps": t,
+            "stage_occupancy": [m / t] * n,
+            "bubble_fraction_schedule": (n - 1) / t,
+            "bubble_fraction_sequential": (n - 1) / n,
+        }
+
+
+def _microbatch_imp(codec, hop_imps, s: int, mb: int, mb_rows: int):
+    """The importance entry one (cut, µ-batch) hop ships: per-row (B, S)
+    importance is sliced to the µ-batch's own rows (static slice — mb is a
+    Python int in the schedule), shared (S,) importance is passed whole."""
+    if not codec.needs_importance:
+        return None
+    imp = hop_imps[s]
+    if imp.ndim == 2:
+        return jax.lax.slice_in_dim(imp, mb * mb_rows, (mb + 1) * mb_rows,
+                                    axis=0)
+    return imp
+
+
+def run_pipeline_stages_microbatched(n_stages: int, codecs: list,
+                                     num_microbatches: int, run_stage, hidden,
+                                     hop_imps=None, axis_name: str = "stage",
+                                     link=None, fault_key=None,
+                                     fused_plans=None):
+    """Micro-batch pipelined twin of :func:`run_pipeline_stages` (must run
+    inside shard_map on ``axis_name``).
+
+    Fill/steady/drain over T = M + n_stages - 1 unroll steps. Each device
+    keeps one µ-batch-sized activation register; at step t the device at
+    stage s is working on µ-batch b = t - s (valid iff 0 <= b < M — the
+    fill and drain triangles are masked, their compute discarded). Stage 0
+    ingests µ-batch t while t < M; the last stage emits µ-batch
+    t - (n_stages - 1) as it completes. Hops run in REVERSED cut order so a
+    cut's send reads the activation its stage just computed before the
+    upstream cut's receive overwrites the register with the next µ-batch.
+    Because both t and s are Python ints, the µ-batch index mb = t - s of
+    every hop is static: hops outside [0, M) are simply not traced (the
+    wire carries exactly M payloads per cut, which the
+    "split.*.pipelined" lint contracts count), and under ``link`` each
+    µ-batch draws its own fault key (``fold_in(fault_key, mb)``) and bumps
+    its own counter row — the return value's counters are {key: (M,
+    n_hops)}, one row per µ-batch, psum-replicated like the sequential
+    path's.
+
+    Output: the M emitted (B/M, ...) blocks are stacked, psum-replicated
+    in ONE collective, and re-flattened to the caller's (B, ...) batch —
+    same contract as the sequential function, one psum in the graph."""
+    idx = jax.lax.axis_index(axis_name)
+    m = int(num_microbatches)
+    n_hops = n_stages - 1
+    batch = hidden.shape[0]
+    mb_rows = batch // m
+    micro = [jax.lax.slice_in_dim(hidden, b * mb_rows, (b + 1) * mb_rows,
+                                  axis=0) for b in range(m)]
+    counters = ([link.init_counters(n_hops) for _ in range(m)]
+                if link is not None else None)
+    act = jnp.zeros_like(micro[0])
+    outs = []
+    for t in range(m + n_stages - 1):
+        if t < m:
+            act = jnp.where(idx == 0, micro[t], act)
+        here = t - idx  # which µ-batch THIS device holds (traced)
+        valid = (here >= 0) & (here < m)
+        computed = run_stage(act)
+        act = jnp.where(valid, computed, act)
+        if 0 <= t - (n_stages - 1) < m:
+            outs.append(jnp.where(idx == n_stages - 1, act,
+                                  jnp.zeros_like(act)))
+        for s in reversed(range(n_hops)):
+            mb = t - s  # static: only in-flight (cut, µ-batch) hops trace
+            if not 0 <= mb < m:
+                continue
+            if link is not None:
+                imp = _microbatch_imp(codecs[s], hop_imps, s, mb, mb_rows)
+                act, counters[mb] = link.hop(
+                    codecs[s], act, s, axis_name, idx,
+                    jax.random.fold_in(fault_key, mb), counters[mb],
+                    hop_imp=imp)
+                continue
+            if fused_plans is not None and fused_plans[s] is not None:
+                act = fused_hop(fused_plans[s], codecs[s], act, s,
+                                axis_name, idx, n_dev=n_stages)
+                continue
+            imp = _microbatch_imp(codecs[s], hop_imps, s, mb, mb_rows)
+            payload = (codecs[s].encode(act, imp) if imp is not None
+                       else codecs[s].encode(act))
+            moved = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, axis_name, [(s, s + 1)]),
+                payload)
+            act = jnp.where(idx == s + 1, codecs[s].decode(moved), act)
+    out = jax.lax.psum(jnp.stack(outs), axis_name)  # (M, B/M, ...)
+    out = out.reshape((batch,) + out.shape[2:])
+    if link is None:
+        return out
+    counters = {k: jax.lax.psum(jnp.stack([c[k] for c in counters]),
+                                axis_name)
+                for k in counters[0]}
+    return out, counters
+
+
+def run_pipeline_stages_carry_microbatched(n_stages: int, codecs: list,
+                                           num_microbatches: int, run_stage,
+                                           hidden, carry,
+                                           axis_name: str = "stage",
+                                           link=None, fault_key=None,
+                                           fused_plans=None):
+    """:func:`run_pipeline_stages_microbatched` for stage bodies that
+    thread stage-local state (the decode KV caches): ``run_stage(h_mu,
+    carry, b, valid) -> (h_mu, carry)`` where ``b`` is the device's current
+    µ-batch index clipped into [0, M) (traced — each device is at a
+    different µ-batch in the same unroll step) and ``valid`` gates the fill
+    and drain triangles. The stage body owns the µ-batch view of its carry
+    — slicing the µ-batch's cache rows at ``b`` and masking the write-back
+    when ``valid`` is False (contiguous caches) or redirecting it to the
+    trash page (paged pools) — so each µ-batch's cache rows update exactly
+    once per token, same as the sequential schedule. Returns (hidden,
+    carry) plus the {key: (M, n_hops)} psum-replicated counters when
+    ``link`` is given."""
+    idx = jax.lax.axis_index(axis_name)
+    m = int(num_microbatches)
+    n_hops = n_stages - 1
+    batch = hidden.shape[0]
+    mb_rows = batch // m
+    micro = [jax.lax.slice_in_dim(hidden, b * mb_rows, (b + 1) * mb_rows,
+                                  axis=0) for b in range(m)]
+    counters = ([link.init_counters(n_hops) for _ in range(m)]
+                if link is not None else None)
+    act = jnp.zeros_like(micro[0])
+    outs = []
+    for t in range(m + n_stages - 1):
+        if t < m:
+            act = jnp.where(idx == 0, micro[t], act)
+        here = t - idx
+        valid = (here >= 0) & (here < m)
+        b = jnp.clip(here, 0, m - 1)
+        computed, carry = run_stage(act, carry, b, valid)
+        act = jnp.where(valid, computed, act)
+        if 0 <= t - (n_stages - 1) < m:
+            outs.append(jnp.where(idx == n_stages - 1, act,
+                                  jnp.zeros_like(act)))
+        for s in reversed(range(n_hops)):
+            mb = t - s
+            if not 0 <= mb < m:
+                continue
+            if link is not None:
+                act, counters[mb] = link.hop(
+                    codecs[s], act, s, axis_name, idx,
+                    jax.random.fold_in(fault_key, mb), counters[mb])
+                continue
+            if fused_plans is not None and fused_plans[s] is not None:
+                act = fused_hop(fused_plans[s], codecs[s], act, s,
+                                axis_name, idx, n_dev=n_stages)
+                continue
+            payload = codecs[s].encode(act)
+            moved = jax.tree_util.tree_map(
+                lambda a: jax.lax.ppermute(a, axis_name, [(s, s + 1)]),
+                payload)
+            act = jnp.where(idx == s + 1, codecs[s].decode(moved), act)
+    out = jax.lax.psum(jnp.stack(outs), axis_name)
+    out = out.reshape((batch,) + out.shape[2:])
+    if link is None:
+        return out, carry
+    counters = {k: jax.lax.psum(jnp.stack([c[k] for c in counters]),
+                                axis_name)
+                for k in counters[0]}
+    return out, carry, counters
+
+
 def hop_payload_bytes(codecs, cfg, batch: int, seq: int) -> list:
     """Measured payload bytes per hop for one (batch, seq, D) boundary
     activation — the BASELINE.json metric's numerator, shared by every runtime.
@@ -357,7 +581,8 @@ class SplitRuntime:
                  faults: Optional[FaultConfig] = None,
                  policy: Optional[LinkPolicy] = None,
                  fec: Optional[Any] = None,
-                 hedge: Optional[Any] = None):
+                 hedge: Optional[Any] = None,
+                 pipeline: Optional[PipelineConfig] = None):
         self.cfg = cfg
         self.split = split
         self.mesh = mesh
@@ -365,12 +590,14 @@ class SplitRuntime:
         self.policy = policy if policy is not None else LinkPolicy()
         self.fec = fec
         self.hedge = hedge
+        self.pipeline = pipeline
         # an all-zero-rate config builds the exact fault-free graph: the link
         # machinery only exists in the jaxpr when a fault can actually fire
         # (and a disabled FEC/hedge config traces the exact PR 2 hop)
         self._link = (FaultyLink(faults, self.policy, fec=fec, hedge=hedge)
                       if faults is not None and faults.enabled else None)
         self._counter_accum: list = []
+        self._mb_counter_accum: list = []  # pipelined: {key: (M, n_hops)}
         self._lost_stage: Optional[int] = None
         self.bounds = split.stage_bounds(cfg.num_layers)
         self.stage_size = max(stop - start for start, stop in self.bounds)
@@ -409,6 +636,32 @@ class SplitRuntime:
                     f"codecs {bad} compute scales over the batch axis and would "
                     f"diverge from a single-device run under data parallelism "
                     f"(n_data={mesh.shape['data']}); use per-token codecs or n_data=1")
+        if pipeline is not None and pipeline.enabled:
+            if n_stages < 2:
+                raise ValueError(
+                    "micro-batch pipelining needs a cut to hide hops behind; "
+                    f"got n_stages={n_stages} with num_microbatches="
+                    f"{pipeline.num_microbatches}")
+            if mesh.shape["data"] > 1 or mesh.shape["model"] > 1:
+                raise ValueError(
+                    "micro-batch pipelining supports stage-only meshes "
+                    "(n_data=n_model=1): the µ-batch split owns the batch "
+                    f"axis; got data={mesh.shape['data']}, "
+                    f"model={mesh.shape['model']}")
+            # same row-locality argument as the data-parallel check above:
+            # a batch-wide codec scale changes when the batch is split into
+            # µ-batches, which would break token parity with the sequential
+            # schedule (token-selective codecs again ship per-row importance
+            # under any batch > 1, making their payloads row-local)
+            bad = [c.name for c in self.codecs
+                   if not c.batch_invariant and not c.needs_importance]
+            if bad:
+                raise ValueError(
+                    f"codecs {bad} compute scales over the batch axis; their "
+                    f"payloads change when the batch splits into "
+                    f"{pipeline.num_microbatches} µ-batches, breaking the "
+                    f"token-identity guarantee — use per-token codecs or "
+                    f"num_microbatches=1")
         self._forward = self._build_forward()
         self._decode_fns_cache: dict = {}  # capacity -> (prefill_fn, step_fn)
         self._paged_fns_cache: dict = {}   # pool geometry -> step_fn
@@ -485,6 +738,10 @@ class SplitRuntime:
         mesh = self.mesh
         link = self._link
         fused_plans = self.fused_plans
+        # resolved once at build time, like the fused plans: the disabled /
+        # M == 1 build traces the ORIGINAL schedule functions (the
+        # pipeline-disabled-identity lint pins hold it byte-identical)
+        n_micro = (self.pipeline.num_microbatches if self.pipelined else 1)
 
         tp_axis = "model" if mesh.shape["model"] > 1 else None
 
@@ -509,12 +766,20 @@ class SplitRuntime:
                 return computed
 
             if link is None:
+                if n_micro > 1:
+                    return run_pipeline_stages_microbatched(
+                        n_stages, codecs, n_micro, run_stage, hidden,
+                        hop_imps, fused_plans=fused_plans)
                 return run_pipeline_stages(n_stages, codecs, run_stage, hidden,
                                            hop_imps, fused_plans=fused_plans)
             # one fold per forward call keeps chunks decorrelated while two
             # same-seed runs replay the identical fault sequence
             key = jax.random.fold_in(jax.random.key(link.faults.seed),
                                      fault_step)
+            if n_micro > 1:
+                return run_pipeline_stages_microbatched(
+                    n_stages, codecs, n_micro, run_stage, hidden, hop_imps,
+                    link=link, fault_key=key)
             return run_pipeline_stages(n_stages, codecs, run_stage, hidden,
                                        hop_imps, link=link, fault_key=key)
 
@@ -575,6 +840,14 @@ class SplitRuntime:
         collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
         wire_dtypes=lambda ctx: ctx["wire_dtypes"],
         wire_bytes=lambda ctx: ctx["wire_bytes"])
+    @graph_contract(
+        "split.forward.pipelined",
+        # µ-batch schedule: every cut moves M payloads of (B/M, S, D) —
+        # hop_eqns scales by M, wire bytes are M x the µ-batch payload, and
+        # the M emitted blocks still replicate through ONE stacked psum
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"])
     def forward(self, placed_params: dict, input_ids: jnp.ndarray,
                 hop_importance: Optional[Sequence] = None,
                 fault_step: int = 0) -> jnp.ndarray:
@@ -595,6 +868,8 @@ class SplitRuntime:
         self._check_alive()
         n_hops = len(self.codecs)
         batch, seq = input_ids.shape
+        if self.pipelined:
+            self.pipeline.validate_batch(batch, "forward batch")
         imps = list(hop_importance) if hop_importance is not None else [None] * n_hops
         if len(imps) != n_hops:
             raise ValueError(f"expected {n_hops} hop_importance entries, got {len(imps)}")
@@ -621,8 +896,34 @@ class SplitRuntime:
             return self._forward(placed_params, input_ids, stacked)
         logits, counters = self._forward(placed_params, input_ids, stacked,
                                          jnp.asarray(fault_step, jnp.int32))
-        self._counter_accum.append(counters)
+        self._accum_counters(counters)
         return logits
+
+    @property
+    def pipelined(self) -> bool:
+        """True when the µ-batch schedule is armed (num_microbatches > 1).
+        False — including for ``PipelineConfig(num_microbatches=1)`` — means
+        every entry point dispatches to the original sequential unroll,
+        byte-identical to a pre-pipeline build (lint-pinned)."""
+        return self.pipeline is not None and self.pipeline.enabled
+
+    def pipeline_summary(self) -> dict:
+        """Schedule accounting for the obs gauges and bench artifacts: µ-batch
+        count, unroll length, per-stage occupancy, analytic bubble fraction.
+        Meaningful (occupancy 1/n per stage) even when pipelining is off."""
+        pipe = self.pipeline if self.pipeline is not None else PipelineConfig()
+        return pipe.summary(self.split.n_stages)
+
+    def _accum_counters(self, counters) -> None:
+        """Park one call's replicated counter pytree. Pipelined steps return
+        {key: (M, n_hops)} — the per-µ-batch rows accumulate separately
+        (:meth:`microbatch_counters`) and the hop totals fold into the same
+        (n_hops,) stream :meth:`link_counters` has always reported."""
+        first = next(iter(counters.values()))
+        if getattr(first, "ndim", 1) == 2:
+            self._mb_counter_accum.append(counters)
+            counters = {k: v.sum(axis=0) for k, v in counters.items()}
+        self._counter_accum.append(counters)
 
     def link_counters(self, reset: bool = False) -> Optional[dict]:
         """Per-hop fault counters accumulated over every forward/prefill/step
@@ -638,6 +939,24 @@ class SplitRuntime:
                    for k in self._link.init_counters(n_hops)}
         if reset:
             self._counter_accum = []
+        return tot
+
+    def microbatch_counters(self, reset: bool = False) -> Optional[dict]:
+        """Per-µ-batch fault counters from pipelined steps: {name: (M,
+        n_hops) int64} — row m is the faults µ-batch m's payloads drew on
+        each cut (each µ-batch folds its own fault key, so the rows are
+        decorrelated). None when faults are off or pipelining is disabled.
+        Sequential calls on the same runtime (prefill, verify) are not
+        µ-batched and only appear in :meth:`link_counters`."""
+        if self._link is None or not self.pipelined:
+            return None
+        tot = sum_counters(self._mb_counter_accum)
+        if tot is None:
+            m, n_hops = self.pipeline.num_microbatches, len(self.codecs)
+            tot = {k: np.zeros((m, n_hops), np.int64)
+                   for k in self._link.init_counters(n_hops)}
+        if reset:
+            self._mb_counter_accum = []
         return tot
 
     def wire_summary(self, batch: int, seq: int) -> list:
@@ -687,6 +1006,7 @@ class SplitRuntime:
         layer_pspec = self._layer_pspec
         link = self._link
         fused_plans = self.fused_plans
+        n_micro = (self.pipeline.num_microbatches if self.pipelined else 1)
 
         def _hop_protocol(run_stage, hidden, carry, fault_key):
             """Dispatch the carry protocol with or without the faulty link —
@@ -698,6 +1018,20 @@ class SplitRuntime:
                 return out, c, None
             return run_pipeline_stages_carry(
                 n_stages, codecs, run_stage, hidden, carry,
+                link=link, fault_key=fault_key)
+
+        def _hop_protocol_pipelined(run_stage, hidden, carry, fault_key):
+            """The µ-batch schedule's twin of ``_hop_protocol`` —
+            ``run_stage`` takes the pipelined (h_mu, carry, b, valid)
+            contract. Only decode steps route here; prefill fills the whole
+            cache in one sequential pass either way."""
+            if link is None:
+                out, c = run_pipeline_stages_carry_microbatched(
+                    n_stages, codecs, n_micro, run_stage, hidden, carry,
+                    fused_plans=fused_plans)
+                return out, c, None
+            return run_pipeline_stages_carry_microbatched(
+                n_stages, codecs, n_micro, run_stage, hidden, carry,
                 link=link, fault_key=fault_key)
 
         def stage_prefill(local_layers, local_valid, hidden, cos, sin,
@@ -755,8 +1089,32 @@ class SplitRuntime:
             fkey = None if link is None else jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(link.faults.seed), 0x57E9),
                 pos)
-            out, (kc, vc), counters = _hop_protocol(
-                run_stage, hidden, (k_loc[0], v_loc[0]), fkey)
+            if n_micro == 1:
+                out, (kc, vc), counters = _hop_protocol(
+                    run_stage, hidden, (k_loc[0], v_loc[0]), fkey)
+            else:
+                mb_rows = hidden.shape[0] // n_micro
+
+                def run_stage_mu(h_mu, cache, b, ok):
+                    # each device sits at µ-batch b of the schedule: advance
+                    # ONLY that µ-batch's cache rows, and write nothing on
+                    # the fill/drain steps where ok is False
+                    kc, vc = cache  # (sz, B, capacity, KV, hd)
+                    start = b * mb_rows
+                    kc_mu = jax.lax.dynamic_slice_in_dim(kc, start, mb_rows,
+                                                         axis=1)
+                    vc_mu = jax.lax.dynamic_slice_in_dim(vc, start, mb_rows,
+                                                         axis=1)
+                    h2, (kc2, vc2) = jax.lax.scan(scan_body, h_mu,
+                                                  (lv, valid, kc_mu, vc_mu))
+                    kc = jnp.where(ok, jax.lax.dynamic_update_slice_in_dim(
+                        kc, kc2, start, axis=1), kc)
+                    vc = jnp.where(ok, jax.lax.dynamic_update_slice_in_dim(
+                        vc, vc2, start, axis=1), vc)
+                    return h2, (kc, vc)
+
+                out, (kc, vc), counters = _hop_protocol_pipelined(
+                    run_stage_mu, hidden, (k_loc[0], v_loc[0]), fkey)
             if link is None:
                 return out, kc[None], vc[None]
             return out, kc[None], vc[None], counters
@@ -836,7 +1194,7 @@ class SplitRuntime:
         else:
             logits, kc, vc, counters = prefill_fn(
                 placed_params, input_ids, jnp.asarray(fault_step, jnp.int32))
-            self._counter_accum.append(counters)
+            self._accum_counters(counters)
         return logits, {"k": kc, "v": vc, "length": jnp.asarray(s, jnp.int32)}
 
     @graph_contract(
@@ -854,6 +1212,15 @@ class SplitRuntime:
         wire_dtypes=lambda ctx: ctx["wire_dtypes"],
         wire_bytes=lambda ctx: ctx["wire_bytes"],
         donate=lambda ctx: ctx.get("donate_min", 2))
+    @graph_contract(
+        "split.decode_step.pipelined",
+        # µ-batch twin of split.decode_step: M payloads of (B/M, 1, D) per
+        # cut per step (pipelined_decode_hop_bytes), ONE stacked psum, and
+        # the KV donation discipline intact under the schedule
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"],
+        donate=lambda ctx: ctx.get("donate_min", 2))
     def decode_step(self, placed_params: dict, cache: dict,
                     token_ids: jnp.ndarray) -> tuple:
         """One decode position across the pipeline: each cut quantizes the
@@ -861,6 +1228,9 @@ class SplitRuntime:
         the sealed/verified link, keyed by the cache fill level). Returns
         (logits (B, V) fp32, updated cache)."""
         self._check_alive()
+        if self.pipelined:
+            self.pipeline.validate_batch(int(cache["k"].shape[2]),
+                                         "decode batch")
         capacity = cache["k"].shape[3]
         _, step_fn = self._decode_fns(int(capacity))
         if self._link is None:
@@ -870,13 +1240,27 @@ class SplitRuntime:
             logits, kc, vc, counters = step_fn(
                 placed_params, cache["k"], cache["v"], cache["length"],
                 token_ids)
-            self._counter_accum.append(counters)
+            self._accum_counters(counters)
         return logits, {"k": kc, "v": vc, "length": cache["length"] + 1}
 
     def decode_hop_bytes(self, batch: int) -> list:
         """Measured payload bytes per hop for ONE decode step's (batch, 1, D)
         boundary activation — bytes/token is this divided by ``batch``."""
         return hop_payload_bytes(self.codecs, self.cfg, batch, 1)
+
+    def pipelined_decode_hop_bytes(self, batch: int) -> list:
+        """:meth:`decode_hop_bytes` under the µ-batch schedule: each cut
+        moves M payloads of (batch/M, 1, D) per step instead of one
+        (batch, 1, D) payload (identical totals for row-local codecs, but
+        per-µ-batch sidecars — scales, seals — replicate M-fold). Falls back
+        to the sequential accounting when pipelining is off or ``batch``
+        doesn't µ-batch."""
+        if (not self.pipelined
+                or batch % self.pipeline.num_microbatches or batch < 1):
+            return self.decode_hop_bytes(batch)
+        m = self.pipeline.num_microbatches
+        return [m * b for b in
+                hop_payload_bytes(self.codecs, self.cfg, batch // m, 1)]
 
     # ---------- speculative verify ----------
     #
@@ -892,7 +1276,10 @@ class SplitRuntime:
         (capacity, k) pair. Both are static (cache buffer shape / verify
         window); the fill level rides as a traced scalar, so every verify
         burst of a run reuses one executable — the spec loop is jit-miss-free
-        after the first burst."""
+        after the first burst. Always the sequential schedule: speculation
+        is per-stream (B == 1), so there is no batch to µ-batch — a
+        pipelined runtime's verify bursts trace the unchanged pre-pipeline
+        graph."""
         key = (capacity, k)
         if key in self._verify_fns_cache:
             return self._verify_fns_cache[key]
@@ -1015,7 +1402,7 @@ class SplitRuntime:
             logits, kc, vc, counters = verify_fn(
                 placed_params, cache["k"], cache["v"], cache["length"],
                 token_ids)
-            self._counter_accum.append(counters)
+            self._accum_counters(counters)
         return logits, {"k": kc, "v": vc, "length": cache["length"] + kq}
 
     def verify_hop_bytes(self, batch: int, k: int) -> list:
@@ -1099,6 +1486,7 @@ class SplitRuntime:
         layer_pspec = self._layer_pspec
         link = self._link
         fused_plans = self.fused_plans
+        n_micro = (self.pipeline.num_microbatches if self.pipelined else 1)
 
         def _hop_protocol(run_stage, hidden, carry, fault_key):
             if link is None:
@@ -1110,25 +1498,21 @@ class SplitRuntime:
                 n_stages, codecs, run_stage, hidden, carry,
                 link=link, fault_key=fault_key)
 
+        def _hop_protocol_pipelined(run_stage, hidden, carry, fault_key):
+            if link is None:
+                out, c = run_pipeline_stages_carry_microbatched(
+                    n_stages, codecs, n_micro, run_stage, hidden, carry,
+                    fused_plans=fused_plans)
+                return out, c, None
+            return run_pipeline_stages_carry_microbatched(
+                n_stages, codecs, n_micro, run_stage, hidden, carry,
+                link=link, fault_key=fault_key)
+
         def stage_step_paged(local_layers, local_valid, hidden, kp_loc,
                              vp_loc, page_table, lengths, cos_b, sin_b):
             lv = {k: v[0] for k, v in local_layers.items()}
             valid = local_valid[0]
             hidden = pcast_varying(hidden, ("stage",))
-
-            def scan_body(h, xs):
-                lp, ok, kp, vp = xs
-                out, kp2, vp2 = block_decode_paged(
-                    cfg, lp, h, cos_b, sin_b, kp, vp, page_table, lengths)
-                # padding layers are identity AND must not touch their pages
-                return jnp.where(ok, out, h), (jnp.where(ok, kp2, kp),
-                                               jnp.where(ok, vp2, vp))
-
-            def run_stage(h, cache):
-                kp, vp = cache
-                h2, (kp2, vp2) = jax.lax.scan(scan_body, h,
-                                              (lv, valid, kp, vp))
-                return h2, (kp2, vp2)
 
             # the deepest slot's fill level keys the fault step: distinct as
             # decoding advances, identical across same-seed replays of the
@@ -1136,8 +1520,58 @@ class SplitRuntime:
             fkey = None if link is None else jax.random.fold_in(
                 jax.random.fold_in(jax.random.key(link.faults.seed), 0x57E9),
                 jnp.max(lengths))
-            out, (kp, vp), counters = _hop_protocol(
-                run_stage, hidden, (kp_loc[0], vp_loc[0]), fkey)
+            if n_micro == 1:
+                def scan_body(h, xs):
+                    lp, ok, kp, vp = xs
+                    out, kp2, vp2 = block_decode_paged(
+                        cfg, lp, h, cos_b, sin_b, kp, vp, page_table, lengths)
+                    # padding layers are identity AND must not touch their
+                    # pages
+                    return jnp.where(ok, out, h), (jnp.where(ok, kp2, kp),
+                                                   jnp.where(ok, vp2, vp))
+
+                def run_stage(h, cache):
+                    kp, vp = cache
+                    h2, (kp2, vp2) = jax.lax.scan(scan_body, h,
+                                                  (lv, valid, kp, vp))
+                    return h2, (kp2, vp2)
+
+                out, (kp, vp), counters = _hop_protocol(
+                    run_stage, hidden, (kp_loc[0], vp_loc[0]), fkey)
+            else:
+                mb_rows = hidden.shape[0] // n_micro
+
+                def run_stage_mu(h_mu, cache, b, ok):
+                    # the pool is shared across slots so it is NOT sliced per
+                    # µ-batch; instead each step sees only its µ-batch's slot
+                    # rows of the page table, and fill/drain steps (ok False)
+                    # have their writes routed to the trash page (page 0) so
+                    # no real page is touched
+                    start = b * mb_rows
+                    pt_mu = jax.lax.dynamic_slice_in_dim(page_table, start,
+                                                         mb_rows, axis=0)
+                    pt_mu = jnp.where(ok, pt_mu, 0)
+                    ln_mu = jax.lax.dynamic_slice_in_dim(lengths, start,
+                                                         mb_rows, axis=0)
+                    cb_mu = jax.lax.dynamic_slice_in_dim(cos_b, start,
+                                                         mb_rows, axis=0)
+                    sb_mu = jax.lax.dynamic_slice_in_dim(sin_b, start,
+                                                         mb_rows, axis=0)
+
+                    def scan_body_mu(h, xs):
+                        lp, okl, kp, vp = xs
+                        out, kp2, vp2 = block_decode_paged(
+                            cfg, lp, h, cb_mu, sb_mu, kp, vp, pt_mu, ln_mu)
+                        return jnp.where(okl, out, h), (
+                            jnp.where(okl, kp2, kp), jnp.where(okl, vp2, vp))
+
+                    kp, vp = cache
+                    h2, (kp2, vp2) = jax.lax.scan(scan_body_mu, h_mu,
+                                                  (lv, valid, kp, vp))
+                    return h2, (kp2, vp2)
+
+                out, (kp, vp), counters = _hop_protocol_pipelined(
+                    run_stage_mu, hidden, (kp_loc[0], vp_loc[0]), fkey)
             if link is None:
                 return out, kp[None], vp[None]
             return out, kp[None], vp[None], counters
@@ -1183,6 +1617,14 @@ class SplitRuntime:
         wire_dtypes=lambda ctx: ctx["wire_dtypes"],
         wire_bytes=lambda ctx: ctx["wire_bytes"],
         donate=lambda ctx: ctx.get("donate_min", 2))
+    @graph_contract(
+        "split.decode_step_paged.pipelined",
+        # the ragged twin under the µ-batch schedule: M payloads of
+        # (max_slots/M, 1, D) per cut, pools still donated, one psum
+        collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
+        wire_dtypes=lambda ctx: ctx["wire_dtypes"],
+        wire_bytes=lambda ctx: ctx["wire_bytes"],
+        donate=lambda ctx: ctx.get("donate_min", 2))
     def decode_step_paged(self, placed_params: dict, pool: dict,
                           page_table: jnp.ndarray, lengths: jnp.ndarray,
                           token_ids: jnp.ndarray) -> tuple:
@@ -1196,6 +1638,9 @@ class SplitRuntime:
         position (tests/test_batching.py asserts it end to end)."""
         self._check_alive()
         self._check_decode_supported()
+        if self.pipelined:
+            self.pipeline.validate_batch(int(np.shape(page_table)[0]),
+                                         "paged decode slot count")
         num_pages, page_size = pool["k"].shape[2], pool["k"].shape[3]
         step_fn = self._paged_decode_fns(int(num_pages), int(page_size))
         page_table = jnp.asarray(page_table, jnp.int32)
@@ -1207,7 +1652,7 @@ class SplitRuntime:
             logits, pk, pv, counters = step_fn(
                 placed_params, pool["k"], pool["v"], page_table, lengths,
                 token_ids)
-            self._counter_accum.append(counters)
+            self._accum_counters(counters)
         return logits, {"k": pk, "v": pv}
 
     # ---------- accounting ----------

@@ -113,6 +113,7 @@ _PARAM_KEYS = {
     "fec": "split/serve", "hedge": "split/serve",
     "link_health": "split/serve",
     "deadline": "split", "stage_failure": "split", "recovery": "split",
+    "pipeline": "split/serve",
     "serving": "serve",
     "batching": "serve",
     "speculative": "serve",
@@ -381,6 +382,40 @@ def _validate_params_json(p: dict) -> None:
         if need > bcfg.span:
             die(f"batching: soak requests need {need} cache positions > slot "
                 f"span {bcfg.span} (pages_per_slot x page_size)")
+    if "pipeline" in p:
+        from .parallel.split import PipelineConfig
+
+        if exp not in ("split", "serve"):
+            die("pipeline only applies to experiments 'split' and 'serve'")
+        if "cuts" not in p:
+            die("pipeline schedules micro-batches across the split boundary "
+                "— add 'cuts'/'hop_codecs'")
+        pl = p["pipeline"]
+        if not isinstance(pl, dict):
+            die(f"pipeline must be an object of PipelineConfig fields, "
+                f"got {pl!r}")
+        fields = {f.name for f in dataclasses.fields(PipelineConfig)}
+        bad = sorted(set(pl) - fields)
+        if bad:
+            die(f"pipeline: unknown field(s) {bad}; known: {sorted(fields)}")
+        try:
+            pc = PipelineConfig(**pl)
+        except (TypeError, ValueError) as e:
+            die(f"pipeline: {e}")
+        if pc.enabled and p.get("n_seq", 1) > 1:
+            die("pipeline needs the plain split runtime (n_seq == 1); the "
+                "stage x seq runtime overlaps hops with its ring rotation")
+        if pc.enabled and "batching" in p:
+            ms = p["batching"].get("max_slots", 4)
+            if ms % pc.num_microbatches:
+                die(f"batching.max_slots {ms} must be a multiple of "
+                    f"pipeline.num_microbatches {pc.num_microbatches}")
+        if pc.enabled and "speculative" in p:
+            sp_on = p["speculative"].get("enabled", True)
+            if sp_on:
+                die("pipeline + speculative: the spec loop verifies one "
+                    "stream at a time (B == 1), leaving nothing to "
+                    "micro-batch — drop one of the two blocks")
     if "speculative" in p:
         from .serve.speculative import SpecConfig
 
@@ -411,6 +446,17 @@ def _validate_params_json(p: dict) -> None:
             die("speculative runs the one-stream spec loop; the batcher's "
                 "ragged step verifies one token per slot — drop "
                 "'speculative' or 'batching'")
+
+
+def _pipeline_config(p: dict):
+    """Build the :class:`PipelineConfig` a ``"pipeline"`` params block
+    describes (None when absent) — validated by :func:`_validate_params_json`
+    before anything touches devices."""
+    if "pipeline" not in p:
+        return None
+    from .parallel.split import PipelineConfig
+
+    return PipelineConfig(**p["pipeline"])
 
 
 def _serve_front_config(sv: dict):
@@ -711,7 +757,8 @@ def main(argv=None) -> int:
                 from .codecs.fec import (FECConfig, HedgeConfig, LinkHealth,
                                          LinkHealthConfig)
                 from .parallel import make_stage_mesh
-                from .parallel.split import SplitConfig, SplitRuntime
+                from .parallel.split import (PipelineConfig, SplitConfig,
+                                             SplitRuntime)
 
                 n_stages = len(params_json["cuts"]) + 1
                 n_dev = len(jax.devices())
@@ -733,7 +780,9 @@ def main(argv=None) -> int:
                     fec=(FECConfig(**params_json["fec"])
                          if "fec" in params_json else None),
                     hedge=(HedgeConfig(**params_json["hedge"])
-                           if "hedge" in params_json else None))
+                           if "hedge" in params_json else None),
+                    pipeline=(PipelineConfig(**params_json["pipeline"])
+                              if "pipeline" in params_json else None))
                 if "link_health" in params_json:
                     link_health = LinkHealth(
                         config=LinkHealthConfig(**params_json["link_health"]),
@@ -896,7 +945,8 @@ def main(argv=None) -> int:
                 deadline_s=(args.deadline_s if args.deadline_s is not None
                             else params_json.get("deadline")),
                 stage_failure=params_json.get("stage_failure"),
-                recovery=params_json.get("recovery"))
+                recovery=params_json.get("recovery"),
+                pipeline=_pipeline_config(params_json))
             with open(out("split_eval_results.json"), "w") as f:
                 json.dump(result, f, indent=1)
             print(json.dumps(result))

@@ -200,6 +200,14 @@ class ContinuousBatcher:
                 raise ValueError(
                     "compute_dtype is a local-pool knob; the split runtime "
                     "owns its own dtypes — leave it None")
+            if getattr(split_runtime, "pipelined", False):
+                m = split_runtime.pipeline.num_microbatches
+                if self.bcfg.max_slots % m != 0:
+                    raise ValueError(
+                        f"max_slots={self.bcfg.max_slots} must be a multiple "
+                        f"of num_microbatches={m}: every ragged decode step "
+                        f"feeds the full slot set through the pipelined "
+                        f"schedule, which splits it into {m} equal µ-batches")
         self.placed = placed_params
         # split mode: the host PagedKVCache is the ALLOCATOR only (page
         # table, lengths, free list); the actual K/V pages live per-stage on
@@ -557,6 +565,16 @@ class ContinuousBatcher:
             # different placement the same way recovery checkpoints do
             meta["cuts"] = [int(c) for c in self.rt.split.cuts]
             meta["hop_codecs"] = [c.name for c in self.rt.codecs]
+            # the pipelined schedule partitions the slot set into µ-batches;
+            # record the count (a plan-signature axis, cross-checked on
+            # restore) and — for a running stream — which µ-batch its slot
+            # currently rides in, so operators can attribute per-µ-batch
+            # fault counters back to streams
+            pipe = getattr(self.rt, "pipeline", None)
+            m = int(pipe.num_microbatches) if pipe is not None else 1
+            meta["num_microbatches"] = m
+            if st.status == "running" and m > 1:
+                meta["microbatch"] = int(st.slot // (self.bcfg.max_slots // m))
         return DecodeCheckpoint(arrays, meta).save(path)
 
     def _ckpt_mode(self) -> str:
@@ -577,10 +595,14 @@ class ContinuousBatcher:
                 f"{path} was written for model {meta.get('model')!r}, this "
                 f"batcher runs {_model_sig(self.cfg)!r}")
         if self.rt is not None:
+            pipe = getattr(self.rt, "pipeline", None)
             want = {"cuts": [int(c) for c in self.rt.split.cuts],
-                    "hop_codecs": [c.name for c in self.rt.codecs]}
+                    "hop_codecs": [c.name for c in self.rt.codecs],
+                    # default 1 keeps pre-pipeline checkpoints restorable
+                    "num_microbatches": (int(pipe.num_microbatches)
+                                         if pipe is not None else 1)}
             for k, v in want.items():
-                if meta.get(k) != v:
+                if meta.get(k, 1 if k == "num_microbatches" else None) != v:
                     raise CheckpointError(
                         f"{path} {k}={meta.get(k)!r} does not match this "
                         f"runtime's {k}={v!r}")
@@ -601,7 +623,10 @@ class ContinuousBatcher:
         alloc_n = self.stats["alloc_n"]
         dec = self.stats["decode_s"]
         emitted = self.stats["emitted_tokens"]
+        pipeline = (self.rt.pipeline_summary()
+                    if getattr(self.rt, "pipelined", False) else None)
         return {
+            **({"pipeline": pipeline} if pipeline is not None else {}),
             "streams": self.stats["submitted"],
             "finished": self.stats["finished"],
             "steps": n,

@@ -46,8 +46,8 @@ from ..models.transformer import (KVCache, cache_from_state_dict,
 from ..obs.latency import LatencyObserver
 from ..obs.metrics import (CounterSource, get_registry, record_decode_stats,
                            record_link_counters, record_link_health,
-                           record_probe_decisions, record_recovery_counters,
-                           record_wire_bytes)
+                           record_pipeline_stats, record_probe_decisions,
+                           record_recovery_counters, record_wire_bytes)
 from ..obs.tracing import span as obs_span
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        LocalRuntime, RecoveryConfig, RecoveryCounters,
@@ -301,11 +301,17 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
     record_link_counters(delta)
     if link_health is not None:
         record_link_health(link_health.summary())
+    pipelined = bool(getattr(rt, "pipelined", False))
     if get_registry().enabled and isinstance(rt, CounterSource):
-        record_wire_bytes(rt.decode_hop_bytes(b), kind="decode",
-                          steps=max_new_tokens - 1)
+        # under the µ-batch schedule each cut moves M smaller payloads per
+        # step — report the bytes the wire actually carried
+        hop_bytes = (rt.pipelined_decode_hop_bytes(b) if pipelined
+                     else rt.decode_hop_bytes(b))
+        record_wire_bytes(hop_bytes, kind="decode", steps=max_new_tokens - 1)
         if hasattr(rt, "wire_summary"):
             record_probe_decisions(rt.wire_summary(b, max(s, 1)))
+    if pipelined:
+        record_pipeline_stats(rt.pipeline_summary())
     if stats is not None:
         steps = max_new_tokens - 1
         stats.update(
@@ -315,6 +321,8 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
             decode_steps=steps,
             decode_tokens_per_s=(b * steps / (t2 - t1)) if steps else 0.0,
         )
+        if pipelined:
+            stats["pipeline"] = rt.pipeline_summary()
         if delta is not None:
             stats["link_counters"] = delta
         if link_health is not None:
@@ -400,9 +408,13 @@ def _decode_failover_impl(rt, raw_params, lost_stage: int, prompt_ids,
         from ..parallel.split import SplitRuntime
 
         new_split = rt.split.replan(cfg.num_layers, survivors.shape[0])
+        # the µ-batch schedule survives failover: the batch is unchanged and
+        # the replanned cuts reuse the (batch-invariant) original codec, so
+        # the pipelined runtime's validation still holds on the new mesh
         new_rt = SplitRuntime(cfg, new_split,
                               Mesh(survivors, ("stage", "data", "model")),
-                              faults=rt.faults, policy=rt.policy)
+                              faults=rt.faults, policy=rt.policy,
+                              pipeline=getattr(rt, "pipeline", None))
     else:
         new_rt = LocalRuntime(cfg)  # one survivor: nothing left to cut
     counters.replans += 1
@@ -595,9 +607,14 @@ def resume_split(rt: Any, placed_params: dict, checkpoint_path: str, *,
         ckpt = DecodeCheckpoint.load(checkpoint_path)
     meta = ckpt.meta
     want = runtime_plan_meta(rt)
-    for k, label in (("mode", "runtime mode"), ("model", "model signature"),
-                     ("cuts", "split cuts"), ("hop_codecs", "hop codecs")):
-        if meta.get(k) != want.get(k):
+    # num_microbatches defaults to 1 (sequential) so pre-pipeline
+    # checkpoints resume onto unpipelined runtimes unchanged
+    for k, label, dflt in (("mode", "runtime mode", None),
+                           ("model", "model signature", None),
+                           ("cuts", "split cuts", None),
+                           ("hop_codecs", "hop codecs", None),
+                           ("num_microbatches", "pipeline µ-batch count", 1)):
+        if meta.get(k, dflt) != want.get(k, dflt):
             raise CheckpointError(
                 f"checkpoint {checkpoint_path} was written for {label} "
                 f"{meta.get(k)!r}, the resuming runtime has {want.get(k)!r}; "

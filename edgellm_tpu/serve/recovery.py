@@ -341,6 +341,12 @@ def runtime_plan_meta(rt: Any) -> dict:
     if hasattr(rt, "split"):
         meta["cuts"] = [int(c) for c in rt.split.cuts]
         meta["hop_codecs"] = [c.name for c in rt.codecs]
+        # µ-batch pipelining changes no tokens, but a resumed runtime with a
+        # different schedule would re-trace decode executables mid-stream
+        # and, under faults, draw per-µ-batch fault keys differently — so
+        # the schedule is part of the plan signature (1 == sequential)
+        pipe = getattr(rt, "pipeline", None)
+        meta["num_microbatches"] = int(pipe.num_microbatches) if pipe else 1
     return meta
 
 
