@@ -83,6 +83,16 @@ text format) and a Perfetto-loadable Chrome trace to BENCH_OBS_TRACE_PATH
 BENCH_OBS_TOKENS (default 32), BENCH_OBS_BATCH (default 2), plus the shared
 BENCH_MODEL / BENCH_DTYPE.
 
+BENCH_OBS_LIVE=1 switches to the live-telemetry chaos smoke (see
+``obs_live_main``): the full obs stack plus the flight recorder armed, a
+ServeFront over the 2-stage split runtime with the telemetry endpoint on an
+OS-assigned port, the chaos soak (mid-soak stage kill) on a background
+thread while the foreground scrapes /metrics and /healthz live, and a hard
+assertion that the kill produced exactly one CRC-verified flight artifact.
+Knobs: BENCH_OBS_LIVE_REQUESTS (default 24), BENCH_OBS_LIVE_RATE (default
+2.0), BENCH_OBS_LIVE_FLIGHT_DIR, BENCH_OBS_LIVE_METRICS_PATH,
+BENCH_OBS_LIVE_HEALTH_PATH, plus the shared BENCH_MODEL / BENCH_DTYPE.
+
 BENCH_SOAK=1 switches to the deterministic chaos soak over the serving
 front (see ``soak_main``): seeded Poisson open-loop arrivals pushed through
 a ServeFront on a virtual clock, a mid-soak stage kill and a
@@ -1162,6 +1172,163 @@ def obs_main():
         obs.disable()
 
 
+def obs_live_main():
+    """BENCH_OBS_LIVE=1: the live-telemetry chaos smoke.
+
+    BENCH_OBS exercises the exporters offline; this section exercises the
+    tracing plane's *live* surfaces under failure. The full obs stack plus
+    the flight recorder is armed, a :class:`ServeFront` over the 2-stage
+    split runtime binds the telemetry endpoint to an OS-assigned port, and
+    the chaos soak (scheduled mid-soak stage kill) runs on a background
+    thread while the foreground scrapes ``/metrics`` and ``/healthz``
+    mid-flight; the final scrape of each is written to
+    BENCH_OBS_LIVE_METRICS_PATH (default BENCH_OBS_LIVE_METRICS.prom) and
+    BENCH_OBS_LIVE_HEALTH_PATH (default BENCH_OBS_LIVE_HEALTH.json). After
+    the soak the section asserts the failure contract: the injected stage
+    kill produced EXACTLY ONE flight-recorder artifact (CRC-verified by
+    reading it back), written under BENCH_OBS_LIVE_FLIGHT_DIR (default
+    BENCH_OBS_FLIGHT). Needs >= 2 visible devices for the split kill;
+    below that it emits a skip line. Knobs: BENCH_OBS_LIVE_REQUESTS
+    (default 24), BENCH_OBS_LIVE_RATE (default 2.0), plus the shared
+    BENCH_MODEL / BENCH_DTYPE."""
+    import threading
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu import obs
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.obs.flight import load_flight
+    from edgellm_tpu.serve.frontend import ServeFront
+    from edgellm_tpu.serve.soak import SoakConfig, run_soak
+    from edgellm_tpu.utils.clock import FakeClock
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    n_requests = int(os.environ.get("BENCH_OBS_LIVE_REQUESTS", "24"))
+    rate = float(os.environ.get("BENCH_OBS_LIVE_RATE", "2.0"))
+    flight_dir = os.environ.get("BENCH_OBS_LIVE_FLIGHT_DIR",
+                                "BENCH_OBS_FLIGHT")
+    metrics_path = os.environ.get("BENCH_OBS_LIVE_METRICS_PATH",
+                                  "BENCH_OBS_LIVE_METRICS.prom")
+    health_path = os.environ.get("BENCH_OBS_LIVE_HEALTH_PATH",
+                                 "BENCH_OBS_LIVE_HEALTH.json")
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        # the failure contract needs a stage to kill; no split, no contract
+        line = {"metric": "obs-live chaos smoke", "value": None,
+                "unit": None, "vs_baseline": None,
+                "status": f"skipped_needs_2_devices (found {n_dev})"}
+        _emit(line, {"status": "skipped", "devices": n_dev})
+        return
+
+    from edgellm_tpu.parallel.split import (SplitConfig, SplitRuntime,
+                                            make_stage_mesh)
+    from edgellm_tpu.serve.decode import generate, generate_split
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    clock = FakeClock()
+    cut = cfg.num_layers // 2 - 1
+    rt = SplitRuntime(cfg, SplitConfig(cuts=(cut,),
+                                       hop_codecs=("int8_per_token",)),
+                      make_stage_mesh(2))
+
+    # flight recorder must be armed BEFORE the front exists — the front
+    # installs its live-state contributor at construction
+    obs.enable(obs.ObservabilityConfig(flight_recorder=flight_dir))
+    obs.get_registry().clear()
+    obs.get_tracer().clear()
+    front = ServeFront(cfg, params, split_runtime=rt,
+                       compute_dtype=dtype, clock=clock)
+    port = front.start_obs_server(0)
+    base = f"http://127.0.0.1:{port}"
+    print(f"obs endpoint -> {base}")
+    try:
+        # warm every route the soak can take (split, post-kill local) so
+        # compile time never lands on the virtual service clock
+        prompt_len, new_tokens = 8, 8
+        capacity = -(-(prompt_len + new_tokens) // 16) * 16
+        warm_ids = jnp.asarray(np.zeros((1, prompt_len), np.int32))
+        warm_kw = dict(capacity=capacity, temperature=0.7,
+                       rng_key=jax.random.key(0))
+        generate(cfg, params, warm_ids, new_tokens, compute_dtype=dtype,
+                 **warm_kw)
+        generate_split(rt, rt.place_params(params), warm_ids, new_tokens,
+                       **warm_kw)
+
+        soak = SoakConfig(n_requests=n_requests, arrival_rate=rate,
+                          prompt_len=prompt_len, max_new_tokens=new_tokens,
+                          kill_stage=1)
+        result: dict = {}
+
+        def _drive() -> None:
+            try:
+                result["artifact"] = run_soak(front, soak, clock=clock)
+            except BaseException as e:  # surfaced after join
+                result["error"] = e
+
+        t = threading.Thread(target=_drive, name="obs-live-soak")
+        t.start()
+        scrapes = {"metrics": b"", "healthz": b"", "mid_soak": 0}
+
+        def _scrape() -> None:
+            scrapes["metrics"] = urllib.request.urlopen(
+                base + "/metrics", timeout=2).read()
+            scrapes["healthz"] = urllib.request.urlopen(
+                base + "/healthz", timeout=2).read()
+
+        while t.is_alive():
+            try:
+                _scrape()
+                scrapes["mid_soak"] += 1
+            except OSError:
+                pass  # server warming up / request raced the soak's end
+            time.sleep(0.02)
+        t.join()
+        if "error" in result:
+            raise result["error"]
+        _scrape()  # end-state scrape so the files reflect the whole soak
+
+        artifact = result["artifact"]
+        dumps = list(artifact.get("flight_dumps") or [])
+        if len(dumps) != 1:
+            raise AssertionError(
+                f"stage kill must produce exactly one flight artifact, "
+                f"got {len(dumps)}: {dumps}")
+        payload = load_flight(dumps[0])  # CRC + framing verified here
+        with open(metrics_path, "wb") as f:
+            f.write(scrapes["metrics"])
+        with open(health_path, "wb") as f:
+            f.write(scrapes["healthz"])
+        print(f"live /metrics scrape -> {metrics_path}")
+        print(f"live /healthz scrape -> {health_path}")
+        print(f"flight artifact -> {dumps[0]}")
+
+        outcomes = artifact["outcomes"]
+        line = {
+            "metric": (f"{model_name} obs-live chaos smoke ({n_requests} "
+                       f"reqs, stage kill @1, endpoint scraped live)"),
+            "value": round(artifact["goodput_tokens_per_s"], 2),
+            "unit": "goodput tokens/s (virtual, obs+flight on)",
+            "vs_baseline": None,  # the reference has no telemetry at all
+            "completed": outcomes.get("completed", 0),
+            "failed_over": outcomes.get("failed_over", 0),
+            "mid_soak_scrapes": scrapes["mid_soak"],
+            "flight_artifact": dumps[0],
+            "flight_spans": len(payload.get("spans", [])),
+        }
+        _emit(line, {"obs_live": {
+            "artifact": artifact, "flight_failure": payload.get("failure"),
+            "healthz": json.loads(scrapes["healthz"] or b"{}"),
+        }})
+    finally:
+        front.stop_obs_server()
+        obs.disable()
+
+
 def serve_main():
     """BENCH_SERVE=1: continuous batching vs static batching, same load.
 
@@ -1546,6 +1713,8 @@ def main():
         raise SystemExit(lint_main(["--no-mypy"]))
     if os.environ.get("BENCH_OBS") == "1":
         return _run_section("obs", obs_main)
+    if os.environ.get("BENCH_OBS_LIVE") == "1":
+        return _run_section("obs_live", obs_live_main)
     if os.environ.get("BENCH_RECOVERY") == "1":
         return _run_section("recovery", recovery_main)
     if os.environ.get("BENCH_DECODE") == "1":

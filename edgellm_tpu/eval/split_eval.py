@@ -39,7 +39,9 @@ from ..obs.metrics import (record_link_counters, record_link_health,
                            record_probe_decisions, record_recovery_counters,
                            record_wire_bytes)
 from ..obs.tracing import span as obs_span
+from ..obs.tracing import tracing_enabled
 from ..utils.clock import MONOTONIC
+from ..serve.decode import _emit_hop_spans
 from ..serve.recovery import (DecodeTimeout, RecoveryCounters, StageFailure,
                               StageLostError, Watchdog)
 from .harness import (ResumableDriver, _emit, _iter_window_groups,
@@ -648,6 +650,13 @@ def run_split_eval(
             record_link_health(result["link_health"])
     if recovery_on:
         record_recovery_counters(rcounters)
+    if tracing_enabled() and hasattr(final_rt, "hop_attribution"):
+        # one attribution span per boundary cut for the whole sweep: cut
+        # layer, codec, total wire bytes moved, and the worst ladder outcome
+        _emit_hop_spans(final_rt, result.get("link_counters"),
+                        list(hop_bytes_total),
+                        link_tier=getattr(health, "tier", None),
+                        chunks=int(rd.chunks))
     final_rec = {"final": True, "chunks": rd.chunks, "n_tokens": n_tokens,
                  "ppl": result["ppl"], "wall_s": wall,
                  "hop_bytes_total": hop_bytes_total,

@@ -24,6 +24,13 @@ EG005  Host coercion (``.item()``, ``float(...)``/``int(...)`` of computed
 EG006  Mutation of a captured container (``append``/``update``/subscript
        assignment) inside a function nested under a jit-reachable one —
        the mutation happens once at trace time, not per call.
+EG007  A literal metric name (``registry.counter/gauge/histogram("...")``,
+       direct ``Counter``/``Gauge``/``Histogram`` construction) or span name
+       (``span("...")``/``obs_span("...")``) that is not in the registered
+       vocabulary (``obs/names.py``) — a typo'd name silently creates a
+       series no dashboard ever scrapes. f-string names lint as wildcard
+       patterns against the registered templates; fully dynamic names (a
+       variable) are out of scope.
 
 Reachability: a function is *jit-reachable* when it is (a) decorated with
 ``jax.jit`` (directly or via ``partial``), (b) wrapped by a module-level
@@ -81,6 +88,12 @@ MUTATING_METHODS = frozenset({
     "append", "extend", "insert", "update", "add", "pop", "popitem",
     "remove", "clear", "setdefault", "discard",
 })
+
+#: EG007 vocabulary: registry factory methods, direct metric constructors,
+#: and the span entry points whose first argument is THE name
+METRIC_FACTORY_METHODS = frozenset({"counter", "gauge", "histogram"})
+METRIC_CLASSES = frozenset({"Counter", "Gauge", "Histogram"})
+SPAN_CALLEES = frozenset({"span", "obs_span"})
 
 _DISABLE_RE = re.compile(r"#\s*graphlint:\s*disable(?:=([A-Z0-9, ]+))?")
 
@@ -487,6 +500,58 @@ def _check_decode_loops(index: _ModuleIndex, path: str, emit) -> None:
                          f"value on device")
 
 
+def _literal_name_pattern(node: ast.AST) -> Optional[str]:
+    """The statically-known name of a metric/span call's first argument:
+    a string constant verbatim, an f-string with its holes as ``*``, or
+    None (dynamic — EG007 stands down)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant) and isinstance(piece.value,
+                                                              str):
+                parts.append(piece.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+def _check_registered_names(tree: ast.Module, emit) -> None:
+    """EG007 over every metric/span call site with a literal name."""
+    try:
+        from ..obs import names as obs_names
+    except ImportError:  # pragma: no cover - standalone lint of one file
+        return
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        is_metric = (
+            (isinstance(f, ast.Attribute)
+             and f.attr in METRIC_FACTORY_METHODS)
+            or (isinstance(f, ast.Name) and f.id in METRIC_CLASSES))
+        is_span = ((isinstance(f, ast.Name) and f.id in SPAN_CALLEES)
+                   or (isinstance(f, ast.Attribute)
+                       and f.attr in SPAN_CALLEES))
+        if not (is_metric or is_span):
+            continue
+        pattern = _literal_name_pattern(node.args[0])
+        if pattern is None:
+            continue  # dynamic name: not statically checkable
+        if is_metric and not obs_names.metric_registered(pattern):
+            emit("EG007", node.lineno,
+                 f"metric name {pattern!r} is not in the registered "
+                 f"vocabulary (obs/names.py); register it there or fix "
+                 f"the typo — an unregistered series is never scraped")
+        elif is_span and not obs_names.span_registered(pattern):
+            emit("EG007", node.lineno,
+                 f"span name {pattern!r} is not in the registered "
+                 f"vocabulary (obs/names.py); register it there or fix "
+                 f"the typo")
+
+
 # -- driver -----------------------------------------------------------------
 
 
@@ -528,6 +593,7 @@ def lint_source(source: str, path: str) -> List[Finding]:
             _check_traced_fn(info, path, emit)
     _check_jit_static(index, tree, emit)
     _check_decode_loops(index, path, emit)
+    _check_registered_names(tree, emit)
 
     seen: Set[Tuple[str, int, str]] = set()
     findings = []

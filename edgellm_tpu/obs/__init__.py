@@ -31,10 +31,14 @@ from __future__ import annotations
 
 import dataclasses
 
-from . import latency, metrics, tracing
+from . import context, flight, latency, metrics, names, server, tracing
+from .context import TraceContext
+from .flight import (FlightRecorder, configure_flight, flight_dump_for,
+                     get_flight_recorder, load_flight)
 from .latency import LatencyObserver
 from .metrics import (Counter, CounterSource, Gauge, Histogram,
                       MetricsRegistry, get_registry)
+from .server import ObsServer
 from .tracing import Tracer, get_tracer, span, trace_capture
 
 
@@ -42,33 +46,62 @@ from .tracing import Tracer, get_tracer, span, trace_capture
 class ObservabilityConfig:
     """Which pillars to arm when observability is requested (the params.json
     ``"observability"`` object and the ``--metrics-out``/``--trace-out``
-    flags both resolve to one of these). All three default on — requesting
-    observability without naming pillars arms the whole subsystem."""
+    flags both resolve to one of these). The three classic pillars default
+    on — requesting observability without naming pillars arms the whole
+    subsystem; the tracing-plane extras (flight recorder, live endpoint)
+    stay opt-in."""
 
     metrics: bool = True
     tracing: bool = True
     latency: bool = True
+    #: False = off; True = record into ``flight_recorder/`` under the cwd;
+    #: a string names the artifact directory
+    flight_recorder: bool | str = False
+    #: None = no live endpoint; 0 = bind an OS-assigned port; else the port
+    obs_port: int | None = None
 
     def __post_init__(self) -> None:
         for f in dataclasses.fields(self):
+            if f.name in ("flight_recorder", "obs_port"):
+                continue
             v = getattr(self, f.name)
             if not isinstance(v, bool):
                 raise ValueError(f"observability.{f.name} must be a boolean, "
                                  f"got {v!r}")
+        fr = self.flight_recorder
+        if not isinstance(fr, (bool, str)):
+            raise ValueError(f"observability.flight_recorder must be a "
+                             f"boolean or a directory path, got {fr!r}")
+        p = self.obs_port
+        if p is not None and (isinstance(p, bool) or not isinstance(p, int)
+                              or not 0 <= p <= 65535):
+            raise ValueError(f"observability.obs_port must be null or an "
+                             f"integer in [0, 65535], got {p!r}")
 
 
 def enable(config: ObservabilityConfig | None = None) -> None:
-    """Arm the global registry/tracer per ``config`` (default: everything)."""
+    """Arm the global registry/tracer per ``config`` (default: everything);
+    opt-in extras also arm the flight recorder and the live endpoint."""
     cfg = config if config is not None else ObservabilityConfig()
     metrics.get_registry().enabled = cfg.metrics
     tracing.configure(enabled=cfg.tracing)
+    if cfg.flight_recorder and flight.get_flight_recorder() is None:
+        out_dir = (cfg.flight_recorder
+                   if isinstance(cfg.flight_recorder, str)
+                   else "flight_recorder")
+        flight.configure_flight(FlightRecorder(out_dir))
+    if cfg.obs_port is not None:
+        server.start_global(cfg.obs_port)
 
 
 def disable() -> None:
     """Back to the default: metrics and tracing both off (the zero-overhead,
-    graph-identical state the lint contract checks)."""
+    graph-identical state the lint contract checks), flight recorder
+    detached, live endpoint stopped."""
     metrics.get_registry().enabled = False
     tracing.configure(enabled=False)
+    flight.configure_flight(None)
+    server.stop_global()
 
 
 def enabled() -> bool:
@@ -76,8 +109,10 @@ def enabled() -> bool:
 
 
 __all__ = [
-    "Counter", "CounterSource", "Gauge", "Histogram", "LatencyObserver",
-    "MetricsRegistry", "ObservabilityConfig", "Tracer", "disable", "enable",
-    "enabled", "get_registry", "get_tracer", "latency", "metrics", "span",
-    "trace_capture", "tracing",
+    "Counter", "CounterSource", "FlightRecorder", "Gauge", "Histogram",
+    "LatencyObserver", "MetricsRegistry", "ObservabilityConfig", "ObsServer",
+    "TraceContext", "Tracer", "configure_flight", "context", "disable",
+    "enable", "enabled", "flight", "flight_dump_for", "get_flight_recorder",
+    "get_registry", "get_tracer", "latency", "load_flight", "metrics",
+    "names", "server", "span", "trace_capture", "tracing",
 ]

@@ -58,10 +58,23 @@ def _label_key(labels: Mapping[str, Any]) -> LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping for quoted label values: backslash,
+    double-quote, and newline (in that order — escaping the escapes first)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """``# HELP`` line escaping: backslash and newline only (quotes are
+    legal in help text)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _label_str(key: LabelKey) -> str:
     if not key:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+    return "{" + ",".join(f'{k}="{_escape_label_value(v)}"'
+                          for k, v in key) + "}"
 
 
 class _Metric:
@@ -298,13 +311,22 @@ class MetricsRegistry:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
 
     def to_prometheus(self) -> str:
-        """Prometheus text exposition format, one block per metric."""
+        """Prometheus text exposition format, one block per metric family.
+
+        Hardened per the format spec: label values escape backslash /
+        double-quote / newline, ``# HELP`` text escapes backslash / newline,
+        and ``# HELP``/``# TYPE`` are emitted exactly once per family even
+        if a family ever gains multiple sample series (histogram ``_bucket``
+        / ``_sum`` / ``_count`` already share one family header)."""
         lines: List[str] = []
+        emitted_headers: set = set()
         for name in self.names():
             m = self._metrics[name]
-            if m.help:
-                lines.append(f"# HELP {name} {m.help}")
-            lines.append(f"# TYPE {name} {m.kind}")
+            if name not in emitted_headers:
+                emitted_headers.add(name)
+                if m.help:
+                    lines.append(f"# HELP {name} {_escape_help(m.help)}")
+                lines.append(f"# TYPE {name} {m.kind}")
             if isinstance(m, Histogram):
                 for le, cum in m.bucket_counts():
                     le_s = "+Inf" if math.isinf(le) else repr(le)
@@ -337,6 +359,12 @@ def record_link_counters(delta: Optional[Mapping[str, Sequence[int]]],
     reg = registry if registry is not None else _REGISTRY
     if not reg.enabled or not delta:
         return
+    if registry is None:  # the flight ring shadows the global adapter path
+        from . import flight as _flight
+
+        rec = _flight.get_flight_recorder()
+        if rec is not None:
+            rec.note_counters("link", dict(delta))
     for key, per_hop in delta.items():
         c = reg.counter(f"edgellm_link_{key}_total",
                         f"per-hop link-ladder counter {key!r}")

@@ -1,0 +1,136 @@
+"""The registered metric/span name tables — the single vocabulary every
+``registry.counter/gauge/histogram(...)`` and ``span(...)`` call site must
+draw from.
+
+Why a table: a typo'd metric name (``"edgellm_hop_bytez"``) is not an error
+anywhere — the registry happily creates the series, dashboards scrape
+nothing, and the mistake is only found weeks later by a human staring at an
+empty panel. graphlint rule EG007 (``lint/ast_rules.py``) closes that hole
+statically: every *literal* name passed to a metric factory or a span
+constructor must appear here, and every f-string name must match one of the
+registered ``*`` templates (the holes are the runtime-varying segment, e.g.
+the fault-counter key in ``edgellm_link_*_total``). Dynamic names (a
+variable first argument) are out of scope — the lint stands down rather
+than guess.
+
+This module is imported by the lint layer, so it must stay stdlib-only and
+import nothing from the rest of the package.
+"""
+from __future__ import annotations
+
+from fnmatch import fnmatchcase
+from typing import FrozenSet, Tuple
+
+__all__ = [
+    "METRIC_NAMES", "METRIC_TEMPLATES", "SPAN_NAMES", "SPAN_TEMPLATES",
+    "metric_registered", "span_registered",
+]
+
+#: every literal metric family name in the package (registry factories and
+#: direct Counter/Gauge/Histogram constructions)
+METRIC_NAMES: FrozenSet[str] = frozenset({
+    # serve front (pre-date the edgellm_ prefix; renaming would break the
+    # serve-report consumers, so they are registered as-is)
+    "serve_requests_total",
+    "serve_ttft_s",
+    "serve_latency_s",
+    "serve_retries_charged_total",
+    "serve_brownout_level",
+    "serve_queue_depth",
+    # decode loop
+    "edgellm_decode_jit_cache_misses_total",
+    "edgellm_decode_steps_total",
+    "edgellm_decode_prefill_s",
+    "edgellm_decode_decode_s",
+    "edgellm_decode_ttft_seconds",
+    "edgellm_decode_token_latency_seconds",
+    # boundary wire
+    "edgellm_wire_bytes_total",
+    # pipelined decode
+    "edgellm_pipeline_microbatches",
+    "edgellm_pipeline_bubble_fraction",
+    "edgellm_pipeline_bubble_fraction_measured",
+    "edgellm_pipeline_stage_occupancy",
+    # speculative decode
+    "edgellm_spec_acceptance_rate",
+    "edgellm_spec_hops_per_token",
+    # fused-hop probe provenance
+    "edgellm_fused_hop_active",
+    "edgellm_fused_hop_decision",
+    "edgellm_fused_probe_win",
+    # tracing plane
+    "edgellm_flight_dumps_total",
+    "edgellm_obs_scrapes_total",
+})
+
+#: templates for adapter families whose middle segment is a runtime key
+#: (fault-counter names, recovery counters, link-health gauges); an f-string
+#: call site lints against these with its holes as ``*``
+METRIC_TEMPLATES: Tuple[str, ...] = (
+    "edgellm_link_*_total",
+    "edgellm_recovery_*_total",
+    "edgellm_link_health_*",
+    "edgellm_spec_*_total",
+)
+
+#: every literal span name
+SPAN_NAMES: FrozenSet[str] = frozenset({
+    # serve/decode.py
+    "generate.prefill",
+    "generate.decode_loop",
+    "generate_split.prefill",
+    "generate_split.decode_loop",
+    "decode.checkpoint_write",
+    "decode.checkpoint_resume",
+    "decode.failover",
+    # serve/speculative.py
+    "generate_spec.prefill",
+    "generate_spec.resume_draft_prefill",
+    "generate_spec.burst_loop",
+    # serve/recovery.py
+    "recovery.checkpoint_save",
+    "recovery.checkpoint_load",
+    # serve/frontend.py + serve/batching.py (request-scoped tracing plane)
+    "serve.submit",
+    "serve.execute",
+    "batch.submit",
+    "batch.admit",
+    "batch.step",
+    # per-cut boundary-hop attribution (decode, speculative, eval)
+    "split.hop",
+    # eval/split_eval.py
+    "eval.checkpoint_write",
+    "eval.failover",
+    "eval.submit_group",
+    "eval.drain_group",
+    "eval.time_hops",
+    "eval.time_decode_hops",
+    # lint graph-layer probe
+    "lint.obs-identity-probe",
+})
+
+#: span-name templates (none yet — span names are all static today); kept so
+#: EG007 treats spans and metrics uniformly
+SPAN_TEMPLATES: Tuple[str, ...] = ()
+
+
+def _registered(pattern: str, names: FrozenSet[str],
+                templates: Tuple[str, ...]) -> bool:
+    if "*" in pattern:
+        # an f-string call site: its hole pattern must be a registered
+        # template verbatim — matching a template *partially* would let
+        # ``f"edgellm_link_{x}z_total"`` slip through
+        return pattern in templates
+    return pattern in names or any(fnmatchcase(pattern, t)
+                                   for t in templates)
+
+
+def metric_registered(name_or_pattern: str) -> bool:
+    """True when a literal metric name (or the ``*``-holed pattern of an
+    f-string call site) is in the registered vocabulary."""
+    return _registered(name_or_pattern, METRIC_NAMES, METRIC_TEMPLATES)
+
+
+def span_registered(name_or_pattern: str) -> bool:
+    """Span-name twin of :func:`metric_registered`."""
+    return _registered(name_or_pattern, SPAN_NAMES, SPAN_TEMPLATES)

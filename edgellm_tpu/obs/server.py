@@ -1,0 +1,194 @@
+"""Live telemetry endpoint: a zero-dependency stdlib ``http.server``
+background thread exposing the obs plane while a run is in flight.
+
+Endpoints:
+
+- ``/metrics``       — the registry's Prometheus text exposition
+  (``text/plain; version=0.0.4``), scrapeable by a stock Prometheus.
+- ``/healthz``       — JSON liveness summary; the serve front installs a
+  provider reporting breaker states, brownout level, queue depth, and the
+  link-health window. Without a provider it reports ``{"status": "ok"}``.
+- ``/snapshot.json`` — the registry's full JSON snapshot plus (when a
+  flight recorder is configured) the live ring state.
+- ``/trace``         — the tracer's Chrome trace of everything recorded so
+  far; save the body and load it at https://ui.perfetto.dev.
+
+Design: ``ThreadingHTTPServer`` on a daemon thread, bound to localhost by
+default; ``port=0`` lets the OS pick (tests and parallel CI jobs). Request
+logging is silenced — the serve loop's stdout is the product. Every
+response is built from a point-in-time snapshot under the collectors' own
+locks, so scraping mid-soak never torn-reads the registry.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+
+from . import flight as _flight
+from . import metrics as _metrics
+from . import tracing as _tracing
+
+__all__ = ["ObsServer", "get_global", "start_global", "stop_global"]
+
+_PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class ObsServer:
+    """One background HTTP server over the (default: global) obs state."""
+
+    def __init__(self, port: int = 0, *, host: str = "127.0.0.1",
+                 registry: Optional["_metrics.MetricsRegistry"] = None,
+                 tracer: Optional["_tracing.Tracer"] = None,
+                 health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+                 flight: Optional["_flight.FlightRecorder"] = None) -> None:
+        self._registry = registry
+        self._tracer = tracer
+        self.health_fn = health_fn
+        self._flight = flight
+        self._host = host
+        self._requested_port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # resolve lazily so a server built before obs.enable() still serves the
+    # armed global collectors
+    def _reg(self) -> "_metrics.MetricsRegistry":
+        return self._registry or _metrics.get_registry()
+
+    def _trc(self) -> "_tracing.Tracer":
+        return self._tracer or _tracing.get_tracer()
+
+    def _fl(self) -> Optional["_flight.FlightRecorder"]:
+        return self._flight or _flight.get_flight_recorder()
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port (resolves ``port=0``), or None before start()."""
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return (f"http://{self._host}:{self.port}"
+                if self._httpd else None)
+
+    # -- endpoint bodies ----------------------------------------------------
+
+    def _count_scrape(self, endpoint: str) -> None:
+        reg = self._reg()
+        if reg.enabled:
+            reg.counter("edgellm_obs_scrapes_total",
+                        "live-endpoint scrapes served").inc(endpoint=endpoint)
+
+    def render(self, path: str) -> Optional[tuple]:
+        """(status, content_type, body bytes) for one GET, None -> 404."""
+        if path == "/metrics":
+            self._count_scrape("metrics")
+            return 200, _PROM_CONTENT_TYPE, \
+                self._reg().to_prometheus().encode("utf-8")
+        if path == "/healthz":
+            self._count_scrape("healthz")
+            health: Dict[str, Any] = {"status": "ok"}
+            if self.health_fn is not None:
+                try:
+                    health = dict(self.health_fn())
+                except Exception as e:  # provider broke: report, stay up
+                    health = {"status": "error", "error": repr(e)}
+            return 200, "application/json", \
+                json.dumps(health, sort_keys=True,
+                           default=repr).encode("utf-8")
+        if path == "/snapshot.json":
+            self._count_scrape("snapshot")
+            snap: Dict[str, Any] = {
+                "metrics": json.loads(self._reg().to_json())}
+            fl = self._fl()
+            if fl is not None:
+                snap["flight"] = fl.snapshot()
+            return 200, "application/json", \
+                json.dumps(snap, sort_keys=True,
+                           default=repr).encode("utf-8")
+        if path == "/trace":
+            self._count_scrape("trace")
+            return 200, "application/json", \
+                json.dumps(self._trc().to_chrome_trace()).encode("utf-8")
+        return None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the bound port."""
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server API)
+                try:
+                    out = server.render(self.path.split("?", 1)[0])
+                except Exception as e:  # never let a scrape kill the thread
+                    self.send_response(500)
+                    body = repr(e).encode("utf-8")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                if out is None:
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                status, ctype, body = out
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args: Any) -> None:
+                pass  # the serve loop owns stdout
+
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="edgellm-obs-server", daemon=True)
+        self._thread.start()
+        return self._httpd.server_address[1]
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None
+
+
+_SERVER: Optional[ObsServer] = None
+
+
+def start_global(port: int, **kwargs: Any) -> ObsServer:
+    """Start (or return) the process-global server — the ``--obs-port`` /
+    params ``"observability": {"obs_port": ...}`` path."""
+    global _SERVER
+    if _SERVER is None:
+        _SERVER = ObsServer(port, **kwargs)
+        _SERVER.start()
+    return _SERVER
+
+
+def get_global() -> Optional[ObsServer]:
+    """The running process-global server, or None — lets late-constructed
+    components (the serve front) attach their health provider to it."""
+    return _SERVER
+
+
+def stop_global() -> None:
+    global _SERVER
+    if _SERVER is not None:
+        _SERVER.stop()
+        _SERVER = None

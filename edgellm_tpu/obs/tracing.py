@@ -29,7 +29,9 @@ import os
 import threading
 import time
 import warnings
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from . import context as _context
 
 __all__ = [
     "Span", "Tracer", "configure", "get_tracer", "span", "trace_capture",
@@ -78,9 +80,15 @@ class Tracer:
         self._lock = threading.Lock()
         self._stack = threading.local()
         self._t0 = time.monotonic()
+        #: closed-span hook (the flight recorder's ring feed); exceptions
+        #: are swallowed — observation must never take down serving
+        self._sink: Optional[Callable[[Span], None]] = None
 
     def _now_us(self) -> float:
         return (time.monotonic() - self._t0) * 1e6
+
+    def set_sink(self, sink: Optional[Callable[[Span], None]]) -> None:
+        self._sink = sink
 
     @contextlib.contextmanager
     def span(self, name: str, **attrs: Any) -> Iterator[Optional[Span]]:
@@ -90,6 +98,10 @@ class Tracer:
         stack = getattr(self._stack, "open", None)
         if stack is None:
             stack = self._stack.open = []
+        labels = _context.current_labels()
+        if labels:  # ambient request labels; explicit span kwargs win
+            labels.update(attrs)
+            attrs = labels
         s = Span(name, self._now_us(), threading.get_ident(), attrs)
         stack.append(s)
         try:
@@ -100,6 +112,12 @@ class Tracer:
             stack.pop()
             with self._lock:
                 self._spans.append(s)
+            sink = self._sink
+            if sink is not None:
+                try:
+                    sink(s)
+                except Exception:  # pragma: no cover - defensive
+                    pass
 
     def spans(self) -> List[Span]:
         with self._lock:

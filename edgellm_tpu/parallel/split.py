@@ -975,6 +975,54 @@ class SplitRuntime:
                             "reason": self.fused_plans[i].reason})}
                 for i in range(len(self.codecs))]
 
+    def hop_attribution(self, delta: Optional[dict],
+                        per_hop_bytes: Optional[list] = None, *,
+                        link_tier: Optional[int] = None) -> list:
+        """Host-side per-cut attribution rows for the tracing plane: one row
+        per boundary hop carrying {hop, cut layer, codec tier, wire bytes,
+        ladder outcome} — what a request-scoped hop span records.
+
+        ``delta`` is one call's :meth:`link_counters` delta (None when the
+        link machinery is off); ``per_hop_bytes`` the call's per-hop wire
+        bytes (already multiplied by its step/burst count); ``link_tier``
+        the LinkHealth degradation tier if the caller tracks one. The
+        outcome collapses the resilience ladder to the *worst* thing that
+        happened on the hop, in severity order: substituted > hedged >
+        retried > repaired > degraded (tier > 0) > clean. Pure host
+        arithmetic on already-synced numpy counters — nothing here touches
+        a traced value.
+        """
+        def counted(key: str, i: int) -> int:
+            if not delta or key not in delta:
+                return 0
+            v = delta[key]
+            try:
+                return int(v[i])
+            except (TypeError, IndexError):
+                return int(v)
+
+        rows = []
+        for i, codec in enumerate(self.codecs):
+            if counted("substituted", i):
+                outcome = "substituted"
+            elif counted("hedge_wins", i):
+                outcome = "hedged"
+            elif counted("retried", i):
+                outcome = "retried"
+            elif counted("repaired", i):
+                outcome = "repaired"
+            elif link_tier:
+                outcome = "degraded"
+            else:
+                outcome = "clean"
+            wire = 0.0
+            if per_hop_bytes is not None and i < len(per_hop_bytes):
+                wire = float(per_hop_bytes[i])
+            rows.append({"hop": i, "cut": int(self.split.cuts[i]),
+                         "codec": codec.name, "wire_bytes": wire,
+                         "outcome": outcome})
+        return rows
+
     # ---------- incremental decode ----------
     #
     # The regime where the paper's boundary-quantization question bites

@@ -479,6 +479,18 @@ def _serve_front_config(sv: dict):
     return ServeFrontConfig(**kwargs)
 
 
+def _attach_front_obs(front) -> None:
+    """Point the live endpoint's ``/healthz`` at this serve front (breaker
+    states, brownout level, queue depth) when ``--obs-port`` or the params
+    ``obs_port`` armed one — the global server starts before the front
+    exists, so the front attaches itself here."""
+    from .obs.server import get_global
+
+    srv = get_global()
+    if srv is not None:
+        srv.health_fn = front.health_summary
+
+
 def _print_serve_report(report: dict) -> None:
     """Human-readable tail for ``--serve-report``: outcome counts,
     reject/shed reasons, per-breaker states, and the brownout/retry-budget
@@ -525,6 +537,66 @@ def _print_fault_report(result: dict) -> None:
         print(f"  tier switches: {result['tier_switches']} "
               f"(final tier {result.get('final_tier', 0)}, "
               f"{result.get('degraded_chunks', 0)} degraded chunk(s))")
+
+
+def _print_trace_report(tracer) -> None:
+    """Human-readable tail for ``--trace-report``: one block per request id
+    showing the span tree the host tracer recorded — wall time, TTFT (first
+    span start -> end of prefill), nested span durations, and every boundary
+    hop's {cut, codec, wire bytes, ladder outcome} attribution line. Spans
+    without a request id (warmup, eval sweeps) are counted but not listed."""
+    events = tracer.to_chrome_trace()["traceEvents"]
+    by_rid: dict = {}
+    unattributed = 0
+    for ev in events:
+        rid = (ev.get("args") or {}).get("rid")
+        if rid is None:
+            unattributed += 1
+        else:
+            by_rid.setdefault(str(rid), []).append(ev)
+    if not by_rid:
+        print(f"trace report: no request-attributed spans "
+              f"({unattributed} unattributed span(s); tracing off, or "
+              f"nothing was submitted)")
+        return
+
+    def _order(rid: str):
+        # "r12" sorts numerically, anything else lexically after
+        tail = rid.lstrip("r")
+        return (0, int(tail), rid) if tail.isdigit() else (1, 0, rid)
+
+    print(f"trace report: {len(by_rid)} request(s), "
+          f"{sum(len(v) for v in by_rid.values())} attributed span(s)"
+          + (f", {unattributed} unattributed" if unattributed else ""))
+    for rid in sorted(by_rid, key=_order):
+        evs = sorted(by_rid[rid], key=lambda e: (e["ts"], -e["dur"]))
+        t0 = min(e["ts"] for e in evs)
+        wall_ms = (max(e["ts"] + e["dur"] for e in evs) - t0) / 1e3
+        prefill = [e for e in evs if e["name"] == "generate.prefill"]
+        head = f"  {rid}: {wall_ms:.2f} ms wall"
+        if prefill:
+            head += (f", ttft "
+                     f"{(prefill[0]['ts'] + prefill[0]['dur'] - t0) / 1e3:.2f}"
+                     f" ms")
+        print(head)
+        open_until: list = []  # end timestamps of still-open ancestors
+        for e in evs:
+            while open_until and e["ts"] >= open_until[-1]:
+                open_until.pop()
+            pad = "    " + "  " * len(open_until)
+            a = dict(e.get("args") or {})
+            a.pop("rid", None)
+            if e["name"] == "split.hop":
+                line = (f"hop {a.pop('hop', '?')}: "
+                        f"cut={a.pop('cut', '?')} codec={a.pop('codec', '?')}"
+                        f" wire_bytes={a.pop('wire_bytes', '?')} "
+                        f"outcome={a.pop('outcome', '?')}")
+            else:
+                line = f"{e['name']} {e['dur'] / 1e3:.2f} ms"
+            if a:
+                line += " " + " ".join(f"{k}={a[k]}" for k in sorted(a))
+            print(pad + line)
+            open_until.append(e["ts"] + e["dur"])
 
 
 def main(argv=None) -> int:
@@ -575,6 +647,19 @@ def main(argv=None) -> int:
                     help="enable host-side span tracing and write the Chrome "
                          "trace-event JSON to PATH (load at ui.perfetto.dev); "
                          "composes with --profile's XLA capture")
+    ap.add_argument("--obs-port", type=int, metavar="PORT",
+                    help="serve the live telemetry endpoint on "
+                         "127.0.0.1:PORT for the duration of the run "
+                         "(/metrics Prometheus text, /healthz JSON, "
+                         "/snapshot.json, /trace Chrome JSON); 0 binds an "
+                         "OS-assigned port, printed at startup; overrides "
+                         "params.json observability.obs_port "
+                         "(REPRODUCING §17)")
+    ap.add_argument("--trace-report", action="store_true",
+                    help="after the experiment, pretty-print per-request "
+                         "span trees from the host tracer — wall time, TTFT, "
+                         "and every boundary hop's {cut, codec, wire bytes, "
+                         "ladder outcome} attribution; implies tracing")
     ap.add_argument("--serve-report", action="store_true",
                     help="serve experiment: after the soak, pretty-print the "
                          "outcome counts, reject/shed reasons, breaker "
@@ -637,14 +722,36 @@ def main(argv=None) -> int:
     from . import obs
 
     obs_params = params_json.get("observability")
-    if args.metrics_out or args.trace_out or obs_params is not None:
+    if (args.metrics_out or args.trace_out or args.trace_report
+            or args.obs_port is not None or obs_params is not None):
         ob_cfg = obs.ObservabilityConfig(**(obs_params or {}))
-        if args.metrics_out or args.trace_out:
+        if args.metrics_out or args.trace_out or args.trace_report:
             ob_cfg = dataclasses.replace(
                 ob_cfg,
                 metrics=ob_cfg.metrics or bool(args.metrics_out),
-                tracing=ob_cfg.tracing or bool(args.trace_out))
+                tracing=(ob_cfg.tracing or bool(args.trace_out)
+                         or args.trace_report))
+        if args.obs_port is not None:
+            try:
+                ob_cfg = dataclasses.replace(ob_cfg, obs_port=args.obs_port)
+            except ValueError as e:
+                raise SystemExit(f"--obs-port: {e}")
+        if ob_cfg.flight_recorder is True:
+            # unnamed recorder: keep the post-mortems with the run's other
+            # artifacts instead of littering the cwd
+            ob_cfg = dataclasses.replace(
+                ob_cfg,
+                flight_recorder=os.path.join(args.output_dir,
+                                             "flight_recorder"))
         obs.enable(ob_cfg)
+        if ob_cfg.obs_port is not None:
+            from .obs.server import get_global
+
+            srv = get_global()
+            if srv is not None:
+                print(f"obs endpoint -> {srv.url}  "
+                      f"(/metrics /healthz /snapshot.json /trace)",
+                      flush=True)
 
     def _export_observability() -> None:
         if args.metrics_out:
@@ -804,6 +911,7 @@ def main(argv=None) -> int:
                 batcher = ContinuousBatcher(cfg, params, bcfg, **split_kw)
                 front = ServeFront(cfg, params, config=front_cfg,
                                    clock=clock, batcher=batcher)
+                _attach_front_obs(front)
                 # warm the ragged step + the soak's prefill shape so compile
                 # time never lands on a request's service clock
                 warm = ContinuousBatcher(cfg, params, bcfg, **split_kw)
@@ -853,6 +961,7 @@ def main(argv=None) -> int:
             front = ServeFront(cfg, params, split_runtime=rt,
                                config=front_cfg, link_health=link_health,
                                clock=clock, speculative=spec)
+            _attach_front_obs(front)
             # pre-warm the jit caches for the soak's one (batch, capacity)
             # plan: the virtual clock advances by measured service time, and
             # folding tens of compile-seconds into the first request would
@@ -1017,6 +1126,8 @@ def main(argv=None) -> int:
             # export even when the experiment dies: a partial trace/snapshot
             # is exactly what a post-mortem needs
             _export_observability()
+            if args.trace_report:
+                _print_trace_report(obs.get_tracer())
 
 
 if __name__ == "__main__":

@@ -61,6 +61,9 @@ import jax.numpy as jnp
 from ..models.configs import ModelConfig
 from ..models.paged_kv import OutOfPages, OutOfSlots, PagedKVCache, \
     paged_decode_step
+from ..obs import context as obs_context
+from ..obs.flight import flight_dump_for
+from ..obs.tracing import span as obs_span
 from .decode import _prefill_jit, _sample
 from .recovery import CheckpointError, DecodeCheckpoint, Watchdog
 
@@ -265,6 +268,9 @@ class ContinuousBatcher:
                                     float(temperature), int(rng_seed))
         self._waiting.append(sid)
         self.stats["submitted"] += 1
+        with obs_span("batch.submit", sid=sid, prompt_len=int(prompt.size),
+                      max_new_tokens=int(max_new_tokens)):
+            pass
         return sid
 
     def pop_result(self, sid: int) -> np.ndarray:
@@ -301,6 +307,15 @@ class ContinuousBatcher:
         the t-1 tokens already fed back (token t-1 is pending feed)."""
         return st.prompt.size + max(st.t - 1, 0)
 
+    def _microbatch_of(self, slot: int) -> int:
+        """Which µ-batch a slot rides in under the pipelined split schedule
+        (0 when pipelining is off or the pool is local) — the attribution
+        label admit spans and stream checkpoints both record."""
+        pipe = (getattr(self.rt, "pipeline", None)
+                if self.rt is not None else None)
+        m = int(pipe.num_microbatches) if pipe is not None else 1
+        return int(slot // (self.bcfg.max_slots // m)) if m > 1 else 0
+
     def _try_admit(self, sid: int) -> bool:
         st = self._streams[sid]
         need_len = (int(st.resume["length"]) if st.resume is not None
@@ -311,6 +326,7 @@ class ContinuousBatcher:
             slot = self.pool.alloc_slot()
         except OutOfSlots:
             return False
+        resumed = st.resume is not None
         t0 = time.monotonic()
         if st.resume is not None:
             if self.rt is not None:
@@ -357,6 +373,9 @@ class ContinuousBatcher:
         self._admit_seq += 1
         self._slot_to_sid[slot] = sid
         self.stats["admitted"] += 1
+        with obs_span("batch.admit", sid=sid, slot=slot,
+                      microbatch=self._microbatch_of(slot), resumed=resumed):
+            pass
         if st.t >= st.max_new_tokens:  # max_new_tokens == 1: prefill is all
             self._finish(st)
         return True
@@ -391,9 +410,11 @@ class ContinuousBatcher:
         self._waiting.appendleft(sid)  # resumed work goes to the head
         self.stats["evicted"] += 1
         if self.bcfg.checkpoint_dir is not None:
-            self.checkpoint_stream(
-                sid, os.path.join(self.bcfg.checkpoint_dir,
-                                  f"stream_{sid}.ckpt"))
+            # bound so the checkpoint-save span carries the stream id
+            with obs_context.bind(sid=sid):
+                self.checkpoint_stream(
+                    sid, os.path.join(self.bcfg.checkpoint_dir,
+                                      f"stream_{sid}.ckpt"))
 
     def _evict_for_pages(self, needed: int, protect: set) -> bool:
         """Evict youngest-admitted running streams (never ``protect``) until
@@ -451,10 +472,14 @@ class ContinuousBatcher:
                 continue  # already evicted by a predecessor's growth
             try:
                 self.pool.ensure(st.slot, self._cache_len(st) + 1)
-            except OutOfPages:
+            except OutOfPages as e:
                 need = self.pool.pages_for(self._cache_len(st) + 1) \
                     - len(self.pool._slot_pages[st.slot])
                 if not self._evict_for_pages(need, {st.sid}):
+                    # unservable growth: capture the pool state post-mortem
+                    # before the scheduler unwinds (once per instance)
+                    flight_dump_for(e, sid=st.sid, slot=st.slot,
+                                    free_pages=self.pool.num_free_pages)
                     raise
                 self.pool.ensure(st.slot, self._cache_len(st) + 1)
         running = self._running()
@@ -496,9 +521,13 @@ class ContinuousBatcher:
                 self.bcfg.compute_dtype)
             self.pool.pool = type(self.pool.pool)(k, v)
         toks_host = np.asarray(toks)  # ONE host sync per step
-        self.stats["decode_s"] += time.monotonic() - t0
+        step_s = time.monotonic() - t0
+        self.stats["decode_s"] += step_s
         self.stats["jit_misses"] += self._step_cache_size() - misses0
         self.stats["steps"] += 1
+        with obs_span("batch.step", step=int(self.stats["steps"]) - 1,
+                      running=len(running), step_ms=round(step_s * 1e3, 3)):
+            pass
 
         advanced = 0
         for st in running:
@@ -531,9 +560,12 @@ class ContinuousBatcher:
             if not self._waiting and not self._slot_to_sid:
                 break
             if self.step() == 0 and self._waiting:
-                raise OutOfPages(
+                exc = OutOfPages(
                     "no stream can make progress: the pool cannot hold even "
                     "one waiting stream — shrink prompts or grow the pool")
+                flight_dump_for(exc, waiting=len(self._waiting),
+                                free_pages=self.pool.num_free_pages)
+                raise exc
         return self.results
 
     # -- checkpoint / restore ----------------------------------------------

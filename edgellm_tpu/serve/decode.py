@@ -49,6 +49,7 @@ from ..obs.metrics import (CounterSource, get_registry, record_decode_stats,
                            record_pipeline_stats, record_probe_decisions,
                            record_recovery_counters, record_wire_bytes)
 from ..obs.tracing import span as obs_span
+from ..obs.tracing import tracing_enabled
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        LocalRuntime, RecoveryConfig, RecoveryCounters,
                        StageLostError, Watchdog, runtime_plan_meta)
@@ -95,6 +96,25 @@ def decode_step_cache_size() -> int:
     """Number of per-step executables compiled so far in this process — the
     jit-cache-miss counter ``generate`` reports deltas of."""
     return _step_jit._cache_size()
+
+
+def _emit_hop_spans(rt: Any, delta: Optional[dict],
+                    per_hop_bytes: Optional[list], *,
+                    link_tier: Optional[int] = None,
+                    **extra: Any) -> None:
+    """One zero-duration ``split.hop`` span per boundary cut, at call
+    granularity: {hop, cut layer, codec, wire bytes, ladder outcome} plus
+    the caller's extras (µ-batch count, spec-burst count) — and, via the
+    ambient :class:`~edgellm_tpu.obs.context.TraceContext`, the request
+    labels. Tracing-gated so disabled tracing skips even the attribution
+    arithmetic; runtimes without a boundary (LocalRuntime) have no
+    ``hop_attribution`` and emit nothing."""
+    if not tracing_enabled() or not hasattr(rt, "hop_attribution"):
+        return
+    for row in rt.hop_attribution(delta, per_hop_bytes,
+                                  link_tier=link_tier):
+        with obs_span("split.hop", **row, **extra):
+            pass
 
 
 def _validate_decode_args(prompt_ids, max_new_tokens, capacity, temperature,
@@ -302,14 +322,24 @@ def generate_split(rt: Any, placed_params: dict, prompt_ids: ArrayLike,
     if link_health is not None:
         record_link_health(link_health.summary())
     pipelined = bool(getattr(rt, "pipelined", False))
-    if get_registry().enabled and isinstance(rt, CounterSource):
+    hop_bytes: Optional[list] = None
+    if isinstance(rt, CounterSource) and (get_registry().enabled
+                                          or tracing_enabled()):
         # under the µ-batch schedule each cut moves M smaller payloads per
         # step — report the bytes the wire actually carried
         hop_bytes = (rt.pipelined_decode_hop_bytes(b) if pipelined
                      else rt.decode_hop_bytes(b))
+    if get_registry().enabled and hop_bytes is not None:
         record_wire_bytes(hop_bytes, kind="decode", steps=max_new_tokens - 1)
         if hasattr(rt, "wire_summary"):
             record_probe_decisions(rt.wire_summary(b, max(s, 1)))
+    _emit_hop_spans(
+        rt, delta,
+        None if hop_bytes is None
+        else [x * (max_new_tokens - 1) for x in hop_bytes],
+        link_tier=getattr(link_health, "tier", None),
+        microbatches=int(getattr(getattr(rt, "pipeline", None),
+                                 "num_microbatches", 1) if pipelined else 1))
     if pipelined:
         record_pipeline_stats(rt.pipeline_summary())
     if stats is not None:
@@ -540,8 +570,19 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
     if resumed and halted_at is None:
         counters.resume_ok += 1
 
+    delta = None
+    if isinstance(rt, CounterSource) and (stats is not None
+                                          or tracing_enabled()):
+        counters1 = rt.link_counters()
+        if counters1 is not None:
+            # after a failover the runtime is new, so deltas vs the original
+            # runtime's baseline are meaningless — report absolute totals
+            delta = {k: [int(x) for x in
+                         (v if counters0 is None or counters.failovers
+                          else v - counters0[k])]
+                     for k, v in counters1.items()}
+    steps = len(toks) - (0 if resume_state is not None else 1)
     if stats is not None:
-        steps = len(toks) - (0 if resume_state is not None else 1)
         stats.update(
             capacity=capacity,
             prefill_s=t1 - t0,
@@ -553,19 +594,22 @@ def _survivable_loop(rt, placed, prompt_ids, max_new_tokens: int,
         if halted_at is not None:
             stats["halted_at_step"] = halted_at
         stats["recovery_counters"] = counters.as_dict()
-        counters1 = rt.link_counters() if isinstance(rt, CounterSource) else None
-        if counters1 is not None:
-            # after a failover the runtime is new, so deltas vs the original
-            # runtime's baseline are meaningless — report absolute totals
-            stats["link_counters"] = {
-                k: [int(x) for x in
-                    (v if counters0 is None or counters.failovers
-                     else v - counters0[k])]
-                for k, v in counters1.items()}
-            record_link_counters(stats["link_counters"])
+        if delta is not None:
+            stats["link_counters"] = delta
+            record_link_counters(delta)
         if observe is not None:
             stats.update(observe.summary())
         record_decode_stats(stats)
+    if tracing_enabled() and hasattr(rt, "hop_attribution"):
+        pipelined = bool(getattr(rt, "pipelined", False))
+        hop_bytes = (rt.pipelined_decode_hop_bytes(b) if pipelined
+                     else rt.decode_hop_bytes(b))
+        _emit_hop_spans(
+            rt, delta, [x * max(steps, 0) for x in hop_bytes],
+            microbatches=int(getattr(getattr(rt, "pipeline", None),
+                                     "num_microbatches", 1)
+                             if pipelined else 1),
+            failovers=int(counters.failovers))
     record_recovery_counters(counters)
     if observe is not None:
         observe.publish()

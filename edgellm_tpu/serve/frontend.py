@@ -49,13 +49,18 @@ from __future__ import annotations
 import dataclasses
 import heapq
 import os
+import threading
 from typing import Any, Optional, Sequence
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import context as obs_context
+from ..obs.flight import flight_dump_for, get_flight_recorder
 from ..obs.metrics import get_registry
+from ..obs.server import ObsServer
+from ..obs.tracing import span as obs_span
 from ..utils.clock import MONOTONIC, Clock
 from .decode import generate, generate_split
 from .overload import (COMPLETED, FAILED, FAILED_OVER, REJECTED, SHED,
@@ -248,6 +253,14 @@ class ServeFront:
         self._queue: list = []      # heap of (-priority, deadline, rid, _Pending)
         self._backlog_s = 0.0       # priced service time sitting in the queue
         self._seq = 0
+        # submit-side state (sequence, queue, backlog) mutates under this
+        # lock so concurrent submitters never mint duplicate request ids or
+        # corrupt the heap; drain stays single-threaded by contract
+        self._submit_lock = threading.Lock()
+        self._obs_server: Optional[ObsServer] = None
+        fl = get_flight_recorder()
+        if fl is not None:
+            fl.set_context_provider(self._flight_context)
         self.records: list[RequestRecord] = []
         self.failovers = 0
         self._plans: dict = {}      # (batch, capacity) -> call count
@@ -332,10 +345,25 @@ class ServeFront:
     # -- submit ------------------------------------------------------------
 
     def submit(self, req: Request) -> int:
-        """Admit (or reject/shed, recorded) one request; returns its id."""
+        """Admit (or reject/shed, recorded) one request; returns its id.
+        Thread-safe: the id sequence and the queue mutate under a lock, and
+        the admission work runs inside a ``serve.submit`` span bound to the
+        request's trace context (so every nested span/metric carries the
+        request id)."""
         now = self.clock()
-        self._seq += 1
-        rid = self._seq
+        with self._submit_lock:
+            self._seq += 1
+            rid = self._seq
+        fl = get_flight_recorder()
+        if fl is not None:
+            fl.note_request(f"r{rid}", priority=int(req.priority),
+                            prompt=int(np.asarray(req.prompt_ids).size),
+                            max_new_tokens=int(req.max_new_tokens))
+        with obs_context.bind(rid=f"r{rid}"):
+            with obs_span("serve.submit", priority=int(req.priority)):
+                return self._submit_impl(rid, req, now)
+
+    def _submit_impl(self, rid: int, req: Request, now: float) -> int:
         depth = len(self._queue)
         self.brownout.observe(depth / self.admission.cfg.max_queue_depth)
         prompt = jnp.asarray(req.prompt_ids)
@@ -363,8 +391,10 @@ class ServeFront:
                         est_s=est, submitted_at=now)
         deadline_key = (now + req.deadline_s if req.deadline_s is not None
                         else float("inf"))
-        heapq.heappush(self._queue, (-req.priority, deadline_key, rid, pend))
-        self._backlog_s += est
+        with self._submit_lock:
+            heapq.heappush(self._queue,
+                           (-req.priority, deadline_key, rid, pend))
+            self._backlog_s += est
         return rid
 
     # -- drain -------------------------------------------------------------
@@ -492,6 +522,13 @@ class ServeFront:
         return out
 
     def _execute(self, p: _Pending) -> RequestRecord:
+        """One request's terminal execution, bound to its trace context —
+        every hop span the decode loops emit below carries the request id."""
+        with obs_context.bind(rid=f"r{p.rid}"):
+            with obs_span("serve.execute", priority=int(p.req.priority)):
+                return self._execute_impl(p)
+
+    def _execute_impl(self, p: _Pending) -> RequestRecord:
         now = self.clock()
         wait = now - p.submitted_at
         b, s = p.prompt.shape
@@ -516,6 +553,9 @@ class ServeFront:
             toks, stats, retries = self._run(p, backend, capacity)
             attempt2 = False
         except StageLostError as e:
+            # post-mortem before routing around (once per instance: the
+            # recorder latch absorbs duplicate dump_for calls downstream)
+            flight_dump_for(e, rid=p.rid, backend=backend)
             self._on_stage_loss(e.stage)
             backend, retry_note = self._choose_route()
             if backend is None:
@@ -528,6 +568,7 @@ class ServeFront:
                 attempt2 = True
                 route_note = f"stage_lost:{e.stage}"
             except (StageLostError, DecodeTimeout) as e2:
+                flight_dump_for(e2, rid=p.rid, backend=backend)
                 reason = (f"stage_lost:{e2.stage}"
                           if isinstance(e2, StageLostError) else "watchdog")
                 return self._finish(p.rid, p.req, b, s, FAILED, reason,
@@ -728,6 +769,9 @@ class ServeFront:
             retries_charged=retries_charged, jit_misses=jit_misses,
             tokens=tokens, recovery=recovery)
         self.records.append(rec)
+        fl = get_flight_recorder()
+        if fl is not None:
+            fl.end_request(f"r{rid}")
         reg = get_registry()
         if reg.enabled:
             reg.counter("serve_requests_total",
@@ -800,6 +844,64 @@ class ServeFront:
             "plans": {f"{b}x{c}": n
                       for (b, c), n in sorted(self._plans.items())},
         }
+
+    # -- live telemetry ----------------------------------------------------
+
+    def _flight_context(self) -> dict:
+        """What the flight recorder folds into every post-mortem artifact:
+        the front's control-plane state at dump time."""
+        ctx: dict = {
+            "queue_depth": len(self._queue),
+            "brownout": self.brownout.summary(),
+            "failovers": self.failovers,
+            "breakers": {n: b.summary()
+                         for n, b in sorted(self._breakers.items())},
+        }
+        if self.link_health is not None:
+            ctx["link_health"] = self.link_health.summary()
+        return ctx
+
+    def health_summary(self) -> dict:
+        """The ``/healthz`` body: degraded whenever any breaker left the
+        closed state or brownout is active, ok otherwise. Read-only — no
+        breaker probes, no controller side effects."""
+        breakers = {n: b.summary()
+                    for n, b in sorted(self._breakers.items())}
+        open_names = [n for n, s in breakers.items()
+                      if s.get("state") != "closed"]
+        status = ("degraded" if open_names or self.brownout.level
+                  else "ok")
+        health: dict = {
+            "status": status,
+            "open_breakers": open_names,
+            "brownout_level": self.brownout.level,
+            "queue_depth": len(self._queue),
+            "records": len(self.records),
+            "failovers": self.failovers,
+        }
+        if self.link_health is not None:
+            health["link_health"] = self.link_health.summary()
+        return health
+
+    def start_obs_server(self, port: int = 0) -> int:
+        """Expose the live telemetry endpoint for this front —
+        ``/healthz`` reports :meth:`health_summary` — and point the armed
+        flight recorder (if any) at the front's control-plane context.
+        Returns the bound port (``port=0`` = OS-assigned)."""
+        if self._obs_server is None:
+            self._obs_server = ObsServer(port, health_fn=self.health_summary)
+            self._obs_server.start()
+        fl = get_flight_recorder()
+        if fl is not None:
+            fl.set_context_provider(self._flight_context)
+        port_ = self._obs_server.port
+        assert port_ is not None  # started above
+        return port_
+
+    def stop_obs_server(self) -> None:
+        if self._obs_server is not None:
+            self._obs_server.stop()
+            self._obs_server = None
 
     # -- graphlint hook ----------------------------------------------------
 

@@ -44,6 +44,7 @@ import numpy as np
 import jax
 
 from ..models.transformer import KVCache, decode_step, prefill
+from ..obs.flight import flight_dump_for
 from ..obs.tracing import span as obs_span
 from ..utils.clock import MONOTONIC, Clock
 
@@ -202,10 +203,16 @@ class Watchdog:
                 checkpoint_fn()
             except Exception:  # noqa: BLE001 — best-effort by contract
                 pass
-        raise DecodeTimeout(
+        exc = DecodeTimeout(
             f"{what} exceeded the {self.deadline_s:g}s deadline "
             f"(elapsed {elapsed:.3f}s); a best-effort checkpoint was "
             f"attempted — resume from it instead of re-running")
+        # post-mortem at the raise site: the recorder (when armed) captures
+        # the span ring + counters exactly once per exception instance, no
+        # matter how many catch sites also call dump_for
+        flight_dump_for(exc, what=what, deadline_s=self.deadline_s,
+                        elapsed_s=round(elapsed, 3))
+        raise exc
 
 
 # ---------------------------------------------------------------------------
@@ -273,7 +280,13 @@ class DecodeCheckpoint:
     @classmethod
     def load(cls, path: str) -> "DecodeCheckpoint":
         with obs_span("recovery.checkpoint_load", path=path):
-            return cls._load_impl(path)
+            try:
+                return cls._load_impl(path)
+            except CheckpointError as e:
+                # a refused restore is a post-mortem moment: snapshot the
+                # ring before the caller unwinds (once per instance)
+                flight_dump_for(e, path=path)
+                raise
 
     @classmethod
     def _load_impl(cls, path: str) -> "DecodeCheckpoint":

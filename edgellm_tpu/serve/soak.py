@@ -42,6 +42,7 @@ from typing import Any, Optional
 import numpy as np
 import jax
 
+from ..obs.flight import get_flight_recorder
 from ..utils.clock import FakeClock
 from .decode import generate, generate_split
 from .frontend import Request, ServeFront
@@ -256,6 +257,7 @@ def run_soak(front: ServeFront, soak: SoakConfig, *, clock: FakeClock,
     max_call = max((r.retries_charged for r in records), default=0)
     budget_bound = (budget["capacity"]
                     + budget["refill_per_s"] * span_s + max_call)
+    fl = get_flight_recorder()
     return {
         "soak": dataclasses.asdict(soak),
         "virtual_span_s": span_s,
@@ -277,5 +279,8 @@ def run_soak(front: ServeFront, soak: SoakConfig, *, clock: FakeClock,
         "retry_budget": {**budget, "max_single_call": max_call,
                          "within_budget": budget["spent"] <= budget_bound},
         "token_identity": identity,
+        # post-mortems captured during the soak (exactly one per injected
+        # failure instance), or None when no flight recorder is armed
+        "flight_dumps": (list(fl.dumps()) if fl is not None else None),
         "report": report,
     }

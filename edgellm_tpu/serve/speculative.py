@@ -68,8 +68,11 @@ from ..obs.metrics import (CounterSource, get_registry, record_decode_stats,
                            record_link_counters, record_link_health,
                            record_probe_decisions, record_recovery_counters,
                            record_spec_stats, record_wire_bytes)
+from ..obs import context as obs_context
 from ..obs.tracing import span as obs_span
-from .decode import _sample, _validate_decode_args, _write_checkpoint
+from ..obs.tracing import tracing_enabled
+from .decode import (_emit_hop_spans, _sample, _validate_decode_args,
+                     _write_checkpoint)
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        RecoveryConfig, RecoveryCounters, Watchdog,
                        runtime_plan_meta)
@@ -394,27 +397,30 @@ def _spec_loop(rt, placed, prompt_ids, max_new_tokens: int, capacity: int,
                     jnp.asarray(feed[k - 1]), compute_dtype)
             dcache = KVCache(dcache.k, dcache.v,
                              jnp.asarray(s + n - 1, jnp.int32))
-            # ---- recovery hooks, at burst granularity ----
+            # ---- recovery hooks, at burst granularity (bound to the burst
+            # index so checkpoint/timeout spans carry spec_burst) ----
             t = n - 1
             if rec is not None:
-                if rec.halt_at_step is not None and t >= rec.halt_at_step:
-                    checkpoint(toks, cache, t)
-                    halted_at = t
-                    break
-                if (rec.checkpoint_every and rec.checkpoint_path
-                        and (t_prev // rec.checkpoint_every
-                             < t // rec.checkpoint_every)):
-                    checkpoint(toks, cache, t)
-                if wd is not None:
-                    ckpt_fn = ((lambda: checkpoint(toks, cache, t))
-                               if rec.checkpoint_path else None)
-                    try:
-                        wd.check(ckpt_fn)
-                    except DecodeTimeout:
-                        counters.watchdog_fires += 1
-                        if stats is not None:
-                            stats["recovery_counters"] = counters.as_dict()
-                        raise
+                with obs_context.bind(spec_burst=bursts):
+                    if rec.halt_at_step is not None and t >= rec.halt_at_step:
+                        checkpoint(toks, cache, t)
+                        halted_at = t
+                        break
+                    if (rec.checkpoint_every and rec.checkpoint_path
+                            and (t_prev // rec.checkpoint_every
+                                 < t // rec.checkpoint_every)):
+                        checkpoint(toks, cache, t)
+                    if wd is not None:
+                        ckpt_fn = ((lambda: checkpoint(toks, cache, t))
+                                   if rec.checkpoint_path else None)
+                        try:
+                            wd.check(ckpt_fn)
+                        except DecodeTimeout:
+                            counters.watchdog_fires += 1
+                            if stats is not None:
+                                stats["recovery_counters"] = \
+                                    counters.as_dict()
+                            raise
 
     out = jnp.asarray(np.stack(toks, axis=1))  # (1, len(toks))
     jax.block_until_ready(out)
@@ -446,6 +452,13 @@ def _spec_loop(rt, placed, prompt_ids, max_new_tokens: int, capacity: int,
         record_wire_bytes(rt.verify_hop_bytes(b, k), kind="verify",
                           steps=bursts)
         record_probe_decisions(rt.wire_summary(b, k))
+    if tracing_enabled() and hasattr(rt, "hop_attribution"):
+        # one hop round per burst: the per-hop wire cost is the k-token
+        # verify payload times the burst count
+        _emit_hop_spans(
+            rt, delta, [x * bursts for x in rt.verify_hop_bytes(b, k)],
+            link_tier=getattr(link_health, "tier", None),
+            spec_bursts=int(bursts))
     if stats is not None:
         stats.update(
             capacity=capacity,

@@ -1,0 +1,620 @@
+"""Request-scoped tracing plane coverage: TraceContext propagation (nesting,
+thread isolation, span-arg merge), the failure flight recorder (bounded ring,
+CRC-framed artifacts, exactly-one dump per failure instance, FakeClock
+determinism), the live telemetry endpoint (all four routes plus 404 over real
+HTTP), EG007 name-vocabulary lint, Prometheus exposition hardening (label /
+HELP escaping round-tripped through a strict parser, one ``# HELP``/``# TYPE``
+per family), ServeFront submit-side thread safety, per-cut boundary-hop
+attribution spans out of ``generate_split``, and the run.py wiring for the
+new ``obs_port`` / ``flight_recorder`` params fields and ``--trace-report``.
+"""
+import json
+import re
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from edgellm_tpu import obs
+from edgellm_tpu.obs import context as obs_context
+from edgellm_tpu.obs.flight import (FlightArtifactError, FlightRecorder,
+                                    configure_flight, flight_dump_for,
+                                    load_flight)
+from edgellm_tpu.obs.metrics import MetricsRegistry
+from edgellm_tpu.obs.server import ObsServer
+from edgellm_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    """Never leak armed process-global obs state across tests."""
+    yield
+    obs.disable()
+    obs.get_registry().clear()
+    obs.get_tracer().clear()
+
+
+# ---------------------------------------------------------------------------
+# TraceContext propagation
+# ---------------------------------------------------------------------------
+
+
+def test_bind_nesting_inherits_and_restores():
+    assert obs_context.current() is None
+    with obs_context.bind(rid="r1") as outer:
+        assert outer.labels() == {"rid": "r1"}
+        with obs_context.bind(spec_burst=3, slot=0) as inner:
+            # refinement inherits the enclosing rid
+            assert inner.labels() == {"rid": "r1", "slot": 0,
+                                      "spec_burst": 3}
+            with obs_context.bind(rid="r2"):
+                assert obs_context.current().rid == "r2"
+            assert obs_context.current().rid == "r1"
+        assert obs_context.current_labels() == {"rid": "r1"}
+    assert obs_context.current() is None
+    assert obs_context.current_labels() == {}
+
+
+def test_context_merges_into_spans_and_explicit_kwargs_win():
+    obs.enable(obs.ObservabilityConfig())
+    with obs_context.bind(rid="r9", slot=3):
+        with obs.span("serve.submit", slot=7, priority=1):
+            pass
+    with obs.span("serve.execute"):  # outside any bind: no context args
+        pass
+    events = {e["name"]: e for e in
+              obs.get_tracer().to_chrome_trace()["traceEvents"]}
+    assert events["serve.submit"]["args"] == {"rid": "r9", "slot": 7,
+                                              "priority": 1}
+    assert "rid" not in events["serve.execute"].get("args", {})
+
+
+def test_context_is_isolated_per_thread():
+    obs.enable(obs.ObservabilityConfig())
+    seen = {}
+
+    def worker(rid):
+        with obs_context.bind(rid=rid):
+            with obs.span("serve.execute"):
+                seen[rid] = obs_context.current().rid
+
+    ts = [threading.Thread(target=worker, args=(f"r{i}",)) for i in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert seen == {f"r{i}": f"r{i}" for i in range(4)}
+    rids = sorted(e["args"]["rid"] for e in
+                  obs.get_tracer().to_chrome_trace()["traceEvents"]
+                  if e["name"] == "serve.execute")
+    assert rids == [f"r{i}" for i in range(4)]
+
+
+def test_next_rid_unique():
+    a, b = obs_context.next_rid(), obs_context.next_rid()
+    assert a != b and a.startswith("r") and b.startswith("r")
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_bounded_and_artifact_round_trip(tmp_path):
+    rec = FlightRecorder(str(tmp_path), capacity=4)
+    configure_flight(rec)
+    try:
+        obs.enable(obs.ObservabilityConfig())
+        for i in range(9):  # tracer sink feeds the ring; ring keeps last 4
+            with obs.span("serve.execute", i=i):
+                pass
+        rec.note_request("r1", priority=1, prompt=8)
+        rec.note_request("r2", priority=0, prompt=4)
+        rec.end_request("r2")
+        rec.note_counters("link", {"retried": [2], "repaired": 1})
+        path = rec.dump("manual", failure=None, note="hello")
+        art = load_flight(path)
+    finally:
+        configure_flight(None)
+    assert art["reason"] == "manual" and art["note"] == "hello"
+    assert [e["args"]["i"] for e in art["spans"]] == [5, 6, 7, 8]
+    assert art["active_requests"] == {"r1": {"priority": 1, "prompt": 8}}
+    assert art["counters"] == [
+        {"kind": "link", "delta": {"retried": [2], "repaired": 1}, "t": None}]
+    assert art["seq"] == 1
+    # the dump itself rode the enabled registry
+    assert obs.get_registry().counter(
+        "edgellm_flight_dumps_total").value(reason="manual") == 1.0
+
+
+def test_flight_dump_exactly_once_per_failure_instance(tmp_path):
+    from edgellm_tpu.serve.recovery import DecodeTimeout
+
+    rec = FlightRecorder(str(tmp_path))
+    configure_flight(rec)
+    try:
+        exc = DecodeTimeout("boom")
+        first = flight_dump_for(exc, where="raise_site")
+        # every catch site may also call dump_for; the instance latch absorbs
+        assert flight_dump_for(exc, where="catch_site") is None
+        assert flight_dump_for(exc) is None
+        other = flight_dump_for(DecodeTimeout("boom 2"))
+        assert rec.dumps() == [first, other]
+    finally:
+        configure_flight(None)
+
+
+def test_flight_dump_is_noop_without_recorder():
+    assert flight_dump_for(RuntimeError("nobody listening")) is None
+
+
+def test_flight_artifact_corruption_detected(tmp_path):
+    rec = FlightRecorder(str(tmp_path))
+    path = rec.dump("corruption_probe")
+    data = bytearray(open(path, "rb").read())
+    load_flight(path)  # sanity: pristine artifact reads back
+
+    flipped = tmp_path / "flipped.bin"
+    data2 = bytearray(data)
+    data2[-1] ^= 0xFF  # payload bit-flip -> CRC mismatch
+    flipped.write_bytes(bytes(data2))
+    with pytest.raises(FlightArtifactError, match="CRC"):
+        load_flight(str(flipped))
+
+    truncated = tmp_path / "truncated.bin"
+    truncated.write_bytes(bytes(data[:len(data) - 5]))
+    with pytest.raises(FlightArtifactError, match="truncated"):
+        load_flight(str(truncated))
+
+    badmagic = tmp_path / "badmagic.bin"
+    data3 = bytearray(data)
+    data3[0:4] = b"NOPE"
+    badmagic.write_bytes(bytes(data3))
+    with pytest.raises(FlightArtifactError, match="magic"):
+        load_flight(str(badmagic))
+
+
+def _timeout_scenario(out_dir):
+    """One injected watchdog timeout on a FakeClock; returns the artifact."""
+    from edgellm_tpu.serve.recovery import DecodeTimeout, Watchdog
+
+    clock = FakeClock()
+    rec = FlightRecorder(str(out_dir), clock=clock)
+    configure_flight(rec)
+    try:
+        wd = Watchdog(1.0, clock=clock)
+        wd.arm()
+        clock.advance(2.5)
+        with pytest.raises(DecodeTimeout) as ei:
+            wd.check(what="test chunk")
+        # the raise site dumped; the catch site's dump_for is a no-op
+        assert flight_dump_for(ei.value) is None
+        (path,) = rec.dumps()
+        return load_flight(path)
+    finally:
+        configure_flight(None)
+
+
+def test_watchdog_timeout_dumps_once_and_deterministically(tmp_path):
+    """The acceptance criterion: one injected DecodeTimeout -> exactly one
+    artifact, and with a FakeClock the payload is bit-stable across runs."""
+    a = _timeout_scenario(tmp_path / "a")
+    b = _timeout_scenario(tmp_path / "b")
+    assert a["failure"]["type"] == "DecodeTimeout"
+    assert a["what"] == "test chunk"
+    assert a["deadline_s"] == 1.0 and a["elapsed_s"] == 2.5
+    assert a["t"] == 2.5  # recorder rode the same fake clock
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# live telemetry endpoint
+# ---------------------------------------------------------------------------
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def test_obs_server_endpoints_and_404(tmp_path):
+    obs.enable(obs.ObservabilityConfig())
+    rec = FlightRecorder(str(tmp_path))
+    configure_flight(rec)  # sink installed before the span closes
+    obs.get_registry().counter("serve_requests_total",
+                               "terminal serve outcomes").inc(
+                                   outcome="completed")
+    with obs.span("serve.submit"):
+        pass
+    srv = ObsServer(0, health_fn=lambda: {"status": "ok", "queue_depth": 0})
+    try:
+        port = srv.start()
+        assert srv.port == port and srv.url.endswith(str(port))
+        base = f"http://127.0.0.1:{port}"
+
+        status, ctype, body = _get(base + "/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert "serve_requests_total" in body.decode()
+
+        status, ctype, body = _get(base + "/healthz")
+        assert status == 200 and ctype == "application/json"
+        assert json.loads(body) == {"status": "ok", "queue_depth": 0}
+
+        status, _, body = _get(base + "/snapshot.json")
+        snap = json.loads(body)
+        assert "serve_requests_total" in snap["metrics"]
+        assert [e["name"] for e in snap["flight"]["spans"]] == \
+            ["serve.submit"]
+
+        status, _, body = _get(base + "/trace")
+        trace = json.loads(body)
+        assert {e["name"] for e in trace["traceEvents"]} == {"serve.submit"}
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        assert ei.value.code == 404
+
+        # the scrapes themselves were metered
+        assert obs.get_registry().counter(
+            "edgellm_obs_scrapes_total").value(endpoint="metrics") == 1.0
+    finally:
+        srv.stop()
+        configure_flight(None)
+    assert srv.port is None  # stop() released the socket
+
+
+def test_healthz_survives_broken_provider():
+    def broken():
+        raise RuntimeError("provider exploded")
+
+    srv = ObsServer(0, health_fn=broken)
+    try:
+        port = srv.start()
+        status, _, body = _get(f"http://127.0.0.1:{port}/healthz")
+        health = json.loads(body)
+        assert status == 200 and health["status"] == "error"
+        assert "provider exploded" in health["error"]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# EG007: the metric/span name vocabulary
+# ---------------------------------------------------------------------------
+
+
+def _eg007(src):
+    from edgellm_tpu.lint.ast_rules import lint_source
+
+    return [f for f in lint_source(src, "t.py") if f.rule == "EG007"]
+
+
+def test_eg007_flags_unregistered_literal_names():
+    src = (
+        "from edgellm_tpu.obs.tracing import span as obs_span\n"
+        "from edgellm_tpu.obs.metrics import Counter, get_registry\n\n"
+        "def f(reg):\n"
+        "    reg.counter('edgellm_bogus_total').inc()\n"
+        "    Counter('also_bogus')\n"
+        "    with obs_span('serve.submitz'):\n"
+        "        pass\n")
+    findings = _eg007(src)
+    assert len(findings) == 3
+    assert all("registered vocabulary" in f.message for f in findings)
+
+
+def test_eg007_accepts_registered_names_templates_and_dynamic():
+    src = (
+        "from edgellm_tpu.obs.tracing import span as obs_span\n\n"
+        "def f(reg, k, name):\n"
+        "    reg.counter('edgellm_wire_bytes_total').inc()\n"
+        "    reg.counter(f'edgellm_link_{k}_total').inc()\n"
+        "    reg.histogram('serve_ttft_s')\n"
+        "    with obs_span('split.hop'):\n"
+        "        pass\n"
+        "    with obs_span(name):\n"  # dynamic: out of scope
+        "        pass\n")
+    assert _eg007(src) == []
+
+
+def test_eg007_fstring_must_match_template_exactly():
+    src = ("def f(reg, k):\n"
+           "    reg.counter(f'edgellm_link_{k}z_total').inc()\n")
+    (finding,) = _eg007(src)
+    assert "edgellm_link_*z_total" in finding.message
+
+
+def test_eg007_suppression_comment():
+    src = ("def f(reg):\n"
+           "    reg.counter('oneoff_debug')  # graphlint: disable=EG007\n")
+    assert _eg007(src) == []
+
+
+def test_shipped_package_uses_only_registered_names():
+    """Every literal call site in the package draws from obs/names.py —
+    the vocabulary table cannot drift from the code."""
+    import os
+
+    import edgellm_tpu
+    from edgellm_tpu.lint.ast_rules import iter_package_files, lint_paths
+
+    pkg_root = os.path.dirname(os.path.abspath(edgellm_tpu.__file__))
+    findings = [f for f in lint_paths(iter_package_files(pkg_root))
+                if f.rule == "EG007"]
+    assert findings == [], [f.format() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition hardening
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? (\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:\\.|[^"\\])*)"')
+
+
+def _unescape(v):
+    out, i = [], 0
+    while i < len(v):
+        if v[i] == "\\" and i + 1 < len(v):
+            out.append({"\\": "\\", '"': '"', "n": "\n"}[v[i + 1]])
+            i += 2
+        else:
+            out.append(v[i])
+            i += 1
+    return "".join(out)
+
+
+def _strict_parse(text):
+    """A strict text-exposition parser: every line must be a valid HELP /
+    TYPE / sample line; returns (samples, helps, types)."""
+    samples, helps, types = [], {}, {}
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            assert name not in helps, f"duplicate HELP for {name}"
+            helps[name] = _unescape(help_text)
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            types[name] = kind
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            labels = {}
+            if m.group(2):
+                consumed = _LABEL_RE.sub("", m.group(2)).replace(",", "")
+                assert consumed == "", f"bad label syntax: {m.group(2)!r}"
+                labels = {k: _unescape(v)
+                          for k, v in _LABEL_RE.findall(m.group(2))}
+            samples.append((m.group(1), labels, float(m.group(3))))
+    return samples, helps, types
+
+
+def test_prometheus_escaping_round_trips_through_strict_parser():
+    reg = MetricsRegistry(enabled=True)
+    nasty = 'a"b\\c\nd'
+    help_text = 'help with \\ backslash\nand "quotes"'
+    reg.counter("serve_requests_total", help_text).inc(2, outcome=nasty)
+    text = reg.to_prometheus()
+    samples, helps, types = _strict_parse(text)
+    assert samples == [("serve_requests_total", {"outcome": nasty}, 2.0)]
+    assert helps["serve_requests_total"] == help_text
+    assert types["serve_requests_total"] == "counter"
+    # the raw exposition never contains an unescaped newline mid-line
+    assert nasty not in text
+
+
+def test_prometheus_help_and_type_once_per_family():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("edgellm_wire_bytes_total", "bytes moved")
+    for hop in range(3):
+        c.inc(10, hop=hop, kind="decode")
+    reg.histogram("serve_ttft_s", "submit -> first token").observe(0.01)
+    text = reg.to_prometheus()
+    for fam in ("edgellm_wire_bytes_total", "serve_ttft_s"):
+        assert text.count(f"# HELP {fam} ") == 1
+        assert text.count(f"# TYPE {fam} ") == 1
+    samples, _, types = _strict_parse(text)
+    assert types["serve_ttft_s"] == "histogram"
+    buckets = [s for s in samples if s[0] == "serve_ttft_s_bucket"]
+    assert buckets and buckets[-1][1]["le"] == "+Inf"
+    assert len([s for s in samples
+                if s[0] == "edgellm_wire_bytes_total"]) == 3
+
+
+# ---------------------------------------------------------------------------
+# ServeFront submit-side thread safety
+# ---------------------------------------------------------------------------
+
+
+def _tiny_front():
+    import jax
+    from edgellm_tpu.models import init_params, tiny_config
+    from edgellm_tpu.serve.frontend import ServeFront
+
+    cfg = tiny_config("qwen2", num_layers=2, hidden_size=32, num_heads=4,
+                      vocab_size=128)
+    params = init_params(cfg, jax.random.key(1))
+    return cfg, params, ServeFront(cfg, params, clock=FakeClock())
+
+
+def test_serve_front_concurrent_submit_is_thread_safe():
+    """8 threads x 6 submits: every request id minted exactly once, every
+    submission queued exactly once, and every serve.submit span carries its
+    own request's rid — no torn heap, no duplicate ids, no cross-labels."""
+    from edgellm_tpu.serve.frontend import Request
+
+    obs.enable(obs.ObservabilityConfig())
+    cfg, params, front = _tiny_front()
+    n_threads, per_thread = 8, 6
+    rids, errors = [], []
+    lock = threading.Lock()
+    start = threading.Barrier(n_threads)
+
+    def worker():
+        try:
+            start.wait(timeout=10)
+            for _ in range(per_thread):
+                rid = front.submit(Request(
+                    prompt_ids=np.ones((4,), np.int32),
+                    max_new_tokens=2))
+                with lock:
+                    rids.append(rid)
+        except Exception as e:  # pragma: no cover - the assert reports it
+            with lock:
+                errors.append(e)
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    total = n_threads * per_thread
+    assert errors == []
+    assert sorted(rids) == list(range(1, total + 1))
+    assert len(front._queue) == total  # all admitted (no deadline, depth ok)
+    spans = [e for e in obs.get_tracer().to_chrome_trace()["traceEvents"]
+             if e["name"] == "serve.submit"]
+    assert sorted(e["args"]["rid"] for e in spans) == \
+        sorted(f"r{i}" for i in range(1, total + 1))
+    # drain stays single-threaded by contract; the queue built under
+    # contention must still execute cleanly end to end
+    records = front.drain(max_requests=4)
+    assert [r.outcome for r in records] == ["completed"] * 4
+
+
+def test_registry_concurrent_inc_is_exact():
+    reg = MetricsRegistry(enabled=True)
+    c = reg.counter("edgellm_decode_steps_total")
+    n_threads, per_thread = 8, 500
+
+    def worker():
+        for _ in range(per_thread):
+            c.inc()
+
+    ts = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value() == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# boundary-hop attribution
+# ---------------------------------------------------------------------------
+
+
+def _tiny_split_rt():
+    import jax
+    from edgellm_tpu.models import init_params, tiny_config
+    from edgellm_tpu.parallel.split import (SplitConfig, SplitRuntime,
+                                            make_stage_mesh)
+
+    cfg = tiny_config("qwen2", num_layers=6, hidden_size=32, num_heads=4,
+                      vocab_size=128)
+    params = init_params(cfg, jax.random.key(1))
+    rt = SplitRuntime(cfg, SplitConfig(cuts=(1,),
+                                       hop_codecs=("int8_per_token",)),
+                      make_stage_mesh(2))
+    return cfg, params, rt
+
+
+def test_hop_attribution_rows_and_ladder_severity():
+    _, _, rt = _tiny_split_rt()
+    (row,) = rt.hop_attribution(None, [120.0])
+    assert row == {"hop": 0, "cut": 1, "codec": "int8_per_token",
+                   "wire_bytes": 120.0, "outcome": "clean"}
+    # worst-wins severity order
+    assert rt.hop_attribution({"substituted": [1], "retried": [9]},
+                              None)[0]["outcome"] == "substituted"
+    assert rt.hop_attribution({"hedge_wins": [1], "repaired": [2]},
+                              None)[0]["outcome"] == "hedged"
+    assert rt.hop_attribution({"retried": [1]}, None,
+                              link_tier=2)[0]["outcome"] == "retried"
+    assert rt.hop_attribution({"repaired": [3]},
+                              None)[0]["outcome"] == "repaired"
+    assert rt.hop_attribution(None, None,
+                              link_tier=1)[0]["outcome"] == "degraded"
+
+
+def test_generate_split_emits_request_labelled_hop_spans():
+    """The tentpole acceptance shape: a traced split decode emits one
+    ``split.hop`` span per cut carrying {cut layer, codec, wire bytes,
+    ladder outcome, µ-batch count} plus the ambient request id."""
+    import jax.numpy as jnp
+    from edgellm_tpu.serve.decode import generate_split
+
+    cfg, params, rt = _tiny_split_rt()
+    obs.enable(obs.ObservabilityConfig())
+    ids = jnp.ones((1, 4), jnp.int32)
+    with obs_context.bind(rid="r77"):
+        generate_split(rt, rt.place_params(params), ids, 4, capacity=16)
+    (hop,) = [e for e in obs.get_tracer().to_chrome_trace()["traceEvents"]
+              if e["name"] == "split.hop"]
+    args = hop["args"]
+    assert args["rid"] == "r77"
+    assert args["hop"] == 0 and args["cut"] == 1
+    assert args["codec"] == "int8_per_token"
+    assert args["wire_bytes"] > 0
+    assert args["outcome"] == "clean"
+    assert args["microbatches"] == 1
+
+
+# ---------------------------------------------------------------------------
+# run.py wiring: new params fields, --obs-port, --trace-report
+# ---------------------------------------------------------------------------
+
+
+def test_run_params_tracing_plane_field_validation(tmp_path):
+    from edgellm_tpu.run import main
+
+    def run_with(ob):
+        p = tmp_path / "params.json"
+        p.write_text(json.dumps({"observability": ob}))
+        main(["--params", str(p), "--model", "tiny-qwen2"])
+
+    with pytest.raises(SystemExit,
+                       match=r"obs_port must be null or an integer"):
+        run_with({"obs_port": 70000})
+    with pytest.raises(SystemExit,
+                       match=r"obs_port must be null or an integer"):
+        run_with({"obs_port": True})
+    with pytest.raises(SystemExit,
+                       match=r"flight_recorder must be a boolean or a "
+                             r"directory path"):
+        run_with({"flight_recorder": 3})
+
+
+def test_run_serve_trace_report_and_obs_port_e2e(tmp_path, capsys):
+    """--trace-report + --obs-port 0 on the serve soak: the endpoint line is
+    printed, and the report groups spans per request with hop attribution
+    riding the split hops."""
+    from edgellm_tpu.run import main
+
+    p = tmp_path / "params.json"
+    p.write_text(json.dumps({
+        "experiment": "serve", "cuts": [1],
+        "hop_codecs": ["int8_per_token"],
+        "serving": {"soak": {"n_requests": 2, "prompt_len": 8,
+                             "max_new_tokens": 4}}}))
+    try:
+        assert main(["--params", str(p), "--model", "tiny-qwen2",
+                     "--output-dir", str(tmp_path / "out"),
+                     "--obs-port", "0", "--trace-report"]) in (0, None)
+    finally:
+        obs.disable()
+    out = capsys.readouterr().out
+    assert "obs endpoint -> http://127.0.0.1:" in out
+    assert "trace report: 2 request(s)" in out
+    assert "  r1:" in out and "  r2:" in out
+    assert "serve.execute" in out
+    assert "cut=1 codec=int8_per_token" in out
+    assert "outcome=clean" in out
