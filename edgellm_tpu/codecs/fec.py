@@ -40,6 +40,7 @@ and a graphlint fingerprint contract asserts it.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from collections import deque
 from typing import Any, Optional
 
@@ -49,6 +50,7 @@ import jax.numpy as jnp
 
 from ..lint import graph_contract
 from ..utils.clock import MONOTONIC, Clock
+from ..utils.concurrency import guarded_by
 from .faults import (_CRC_MULT, _bump, inject_faults, seal_payload,
                      tree_nbytes, verify_payload)
 # the byte-stream flatten/unflatten moved to wire_format.py (the fused hops
@@ -336,6 +338,8 @@ _HEALTH_KEYS = ("hops", "detected", "repaired", "retried", "substituted",
                 "hedge_wins")
 
 
+@guarded_by("_lock", fields=["tier", "switches", "observations", "_window",
+                             "_last_switch"])
 class LinkHealth:
     """Host-side link SLO tracker and tier driver.
 
@@ -346,7 +350,13 @@ class LinkHealth:
     budget with the *unrepaired* corruption rate: ``burn >= degrade_burn``
     steps the codec tier down, ``burn <= promote_burn`` steps it back up.
     Every switch clears the window (the new tier gets a full re-measure) and
-    arms the ``min_dwell_s`` clock, so a noisy link cannot flap the tier."""
+    arms the ``min_dwell_s`` clock, so a noisy link cannot flap the tier.
+
+    Thread-safe: the decode thread observes while the obs scrape thread
+    reads :meth:`summary` and the rate properties, so window/tier state
+    mutates under ``_lock``. The registry publish happens *outside* the
+    lock (it re-enters :meth:`summary`, and holding a lock across the
+    metrics adapters would be a threadlint EG102/EG103 hazard)."""
 
     def __init__(self, n_tiers: int = 1,
                  config: Optional[LinkHealthConfig] = None,
@@ -356,6 +366,7 @@ class LinkHealth:
         self.cfg = config if config is not None else LinkHealthConfig()
         self.n_tiers = n_tiers
         self.clock = clock
+        self._lock = threading.Lock()
         self.tier = 0
         self.switches = 0
         self.observations = 0
@@ -368,28 +379,29 @@ class LinkHealth:
             for k in _HEALTH_KEYS:
                 if k in counters:
                     tot[k] = int(np.asarray(counters[k]).sum())
-        self._window.append(tot)
-        self.observations += 1
-        if len(self._window) < self.cfg.window:
-            self._publish()
-            return self.tier  # not enough evidence yet
-        burn = self.burn_rate
-        now = self.clock()
-        dwell_ok = (self._last_switch is None
-                    or now - self._last_switch >= self.cfg.min_dwell_s)
-        if (burn >= self.cfg.degrade_burn and dwell_ok
-                and self.tier < self.n_tiers - 1):
-            self.tier += 1
-            self.switches += 1
-            self._last_switch = now
-            self._window.clear()
-        elif (burn <= self.cfg.promote_burn and dwell_ok and self.tier > 0):
-            self.tier -= 1
-            self.switches += 1
-            self._last_switch = now
-            self._window.clear()
+        with self._lock:
+            self._window.append(tot)
+            self.observations += 1
+            if len(self._window) == self.cfg.window:
+                burn = self._burn_rate_locked()
+                now = self.clock()
+                dwell_ok = (self._last_switch is None
+                            or now - self._last_switch >= self.cfg.min_dwell_s)
+                if (burn >= self.cfg.degrade_burn and dwell_ok
+                        and self.tier < self.n_tiers - 1):
+                    self.tier += 1
+                    self.switches += 1
+                    self._last_switch = now
+                    self._window.clear()
+                elif (burn <= self.cfg.promote_burn and dwell_ok
+                      and self.tier > 0):
+                    self.tier -= 1
+                    self.switches += 1
+                    self._last_switch = now
+                    self._window.clear()
+            tier = self.tier
         self._publish()
-        return self.tier
+        return tier
 
     def _publish(self) -> None:
         """Mirror the windowed SLO fields into the global obs registry.
@@ -400,43 +412,62 @@ class LinkHealth:
         if get_registry().enabled:
             record_link_health(self.summary())
 
-    def _sum(self, key: str) -> int:
+    def _sum_locked(self, key: str) -> int:
         return sum(o[key] for o in self._window)
 
     @property
     def corruption_rate(self) -> float:
-        return self._sum("detected") / max(self._sum("hops"), 1)
+        with self._lock:
+            return self._sum_locked("detected") / max(
+                self._sum_locked("hops"), 1)
 
     @property
     def repair_rate(self) -> float:
         """Fraction of detected corruption healed in band."""
-        return self._sum("repaired") / max(self._sum("detected"), 1)
+        with self._lock:
+            return self._sum_locked("repaired") / max(
+                self._sum_locked("detected"), 1)
 
     @property
     def retry_rate(self) -> float:
-        return self._sum("retried") / max(self._sum("hops"), 1)
+        with self._lock:
+            return self._sum_locked("retried") / max(
+                self._sum_locked("hops"), 1)
 
     @property
     def hedge_win_rate(self) -> float:
-        return self._sum("hedge_wins") / max(self._sum("hops"), 1)
+        with self._lock:
+            return self._sum_locked("hedge_wins") / max(
+                self._sum_locked("hops"), 1)
+
+    def _burn_rate_locked(self) -> float:
+        unrepaired = (self._sum_locked("detected")
+                      - self._sum_locked("repaired"))
+        return ((unrepaired / max(self._sum_locked("hops"), 1))
+                / self.cfg.error_budget)
 
     @property
     def burn_rate(self) -> float:
         """Windowed unrepaired-corruption rate over the error budget; >= 1
         means the link is out of SLO at the current tier."""
-        unrepaired = self._sum("detected") - self._sum("repaired")
-        return (unrepaired / max(self._sum("hops"), 1)) / self.cfg.error_budget
+        with self._lock:
+            return self._burn_rate_locked()
 
     def summary(self) -> dict:
-        return {
-            "tier": self.tier,
-            "switches": self.switches,
-            "observations": self.observations,
-            "window": len(self._window),
-            "error_budget": self.cfg.error_budget,
-            "burn_rate": self.burn_rate,
-            "corruption_rate": self.corruption_rate,
-            "repair_rate": self.repair_rate,
-            "retry_rate": self.retry_rate,
-            "hedge_win_rate": self.hedge_win_rate,
-        }
+        with self._lock:
+            return {
+                "tier": self.tier,
+                "switches": self.switches,
+                "observations": self.observations,
+                "window": len(self._window),
+                "error_budget": self.cfg.error_budget,
+                "burn_rate": self._burn_rate_locked(),
+                "corruption_rate": self._sum_locked("detected") / max(
+                    self._sum_locked("hops"), 1),
+                "repair_rate": self._sum_locked("repaired") / max(
+                    self._sum_locked("detected"), 1),
+                "retry_rate": self._sum_locked("retried") / max(
+                    self._sum_locked("hops"), 1),
+                "hedge_win_rate": self._sum_locked("hedge_wins") / max(
+                    self._sum_locked("hops"), 1),
+            }

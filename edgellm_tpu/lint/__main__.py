@@ -31,24 +31,35 @@ def _bootstrap_jax() -> None:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m edgellm_tpu.lint",
-        description="graphlint: AST footgun rules + jaxpr-level graph "
-                    "contracts for the split-decode stack (REPRODUCING §8)")
+        description="graphlint: AST footgun rules, thread/lock-discipline "
+                    "rules (EG1xx) + jaxpr-level graph contracts for the "
+                    "split-decode stack (REPRODUCING §8)")
     ap.add_argument("--json", metavar="PATH", default=None,
                     help="write the merged JSON report here")
+    ap.add_argument("--sarif", metavar="PATH", default=None,
+                    help="also write the report as SARIF 2.1.0 (all layers)")
     ap.add_argument("--ast-only", action="store_true",
                     help="run only the AST rule layer (no jax import)")
     ap.add_argument("--graph-only", action="store_true",
                     help="run only the graph-contract layer")
+    ap.add_argument("--thread-only", action="store_true",
+                    help="run only the thread/lock-discipline layer "
+                         "(EG1xx; no jax import)")
     ap.add_argument("--no-mypy", action="store_true",
                     help="skip the scoped mypy --strict layer")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="list every '# graphlint: disable=' marker with "
+                         "file:line (audit trail for silenced findings)")
     ap.add_argument("paths", nargs="*",
-                    help="AST-lint these files instead of the package "
+                    help="AST/thread-lint these files instead of the package "
                          "(graph layer always targets the real package)")
     args = ap.parse_args(argv)
-    if args.ast_only and args.graph_only:
-        ap.error("--ast-only and --graph-only are mutually exclusive")
+    only_flags = [args.ast_only, args.graph_only, args.thread_only]
+    if sum(only_flags) > 1:
+        ap.error("--ast-only, --graph-only and --thread-only are "
+                 "mutually exclusive")
 
-    from .report import LintReport, merge
+    from .report import LintReport, merge, to_sarif
 
     pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     repo_root = os.path.dirname(pkg_root)
@@ -56,7 +67,7 @@ def main(argv=None) -> int:
     checked: list = []
     skipped: list = []
 
-    if not args.graph_only:
+    if not (args.graph_only or args.thread_only):
         from .ast_rules import iter_package_files, lint_paths
 
         targets = args.paths or list(iter_package_files(pkg_root))
@@ -69,7 +80,17 @@ def main(argv=None) -> int:
             findings_by_layer.append(ty_findings)
             skipped.extend(ty_skips)
 
-    if not args.ast_only:
+    if not (args.ast_only or args.graph_only):
+        # pure-AST layer like the EG00x rules: runs pre-jax-bootstrap
+        from .threadlint import lint_files as thread_lint_files
+        from .threadlint import lint_package as thread_lint_package
+
+        if args.paths:
+            findings_by_layer.append(thread_lint_files(args.paths))
+        else:
+            findings_by_layer.append(thread_lint_package(pkg_root))
+
+    if not (args.ast_only or args.thread_only):
         _bootstrap_jax()
         from .entrypoints import run_graph_checks
 
@@ -78,11 +99,24 @@ def main(argv=None) -> int:
         checked.extend(g_checked)
         skipped.extend(g_skips)
 
+    if args.show_suppressed:
+        from .ast_rules import collect_suppressions, iter_package_files
+
+        sup_targets = args.paths or list(iter_package_files(pkg_root))
+        marks = collect_suppressions(sup_targets)
+        print(f"suppressions: {len(marks)} marker(s)")
+        for path, line, rules in marks:
+            what = "all rules" if rules is None else ",".join(sorted(rules))
+            print(f"  {path}:{line}: disable={what}")
+
     report = LintReport(findings=merge(*findings_by_layer),
                         checked_contracts=checked, skipped=skipped)
     if args.json:
         with open(args.json, "w", encoding="utf-8") as f:
             f.write(report.to_json() + "\n")
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as f:
+            f.write(to_sarif(report) + "\n")
     print(report.summary())
     return 0 if report.ok else 1
 

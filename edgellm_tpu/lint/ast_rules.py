@@ -631,3 +631,23 @@ def lint_paths(paths) -> List[Finding]:
     for p in paths:
         out.extend(lint_file(p))
     return out
+
+
+def collect_suppressions(paths) -> List[Tuple[str, int, Optional[Set[str]]]]:
+    """Every ``# graphlint: disable=`` marker across ``paths``.
+
+    Returns ``(path, line, rules)`` triples sorted by location; ``rules``
+    is None for a bare ``disable`` (all rules) or the set of rule ids a
+    comma-separated marker names. Feeds the CLI's ``--show-suppressed``
+    audit so silenced lines stay reviewable instead of invisible.
+    """
+    out: List[Tuple[str, int, Optional[Set[str]]]] = []
+    for p in paths:
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        for line, rules in sorted(_suppressed_lines(source).items()):
+            out.append((p, line, rules))
+    return sorted(out, key=lambda t: (t[0], t[1]))

@@ -66,6 +66,56 @@ class LintReport:
         return "\n".join(lines)
 
 
+#: SARIF severity per layer — everything graphlint emits is a build-breaker
+_SARIF_LEVEL = "error"
+
+
+def to_sarif(report: "LintReport") -> str:
+    """Render the merged report as SARIF 2.1.0 (one run, driver=graphlint).
+
+    Covers every layer: AST rules, thread rules, graph contracts and the
+    scoped typechecker all share the flat :class:`Finding` shape, so each
+    becomes one SARIF ``result``. Findings with ``line == 0`` (graph
+    contracts anchor to traced jaxprs, not source lines) omit the
+    ``region`` block but keep the artifact URI.
+    """
+    rule_ids = sorted({f.rule for f in report.findings})
+    results = []
+    for f in report.findings:
+        loc: dict = {
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.where},
+            },
+        }
+        if f.line:
+            loc["physicalLocation"]["region"] = {"startLine": f.line}
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL,
+            "message": {"text": f.message},
+            "locations": [loc],
+            "properties": {"layer": f.layer},
+        })
+    doc = {
+        "$schema": ("https://json.schemastore.org/sarif-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "graphlint",
+                    "rules": [{"id": rid} for rid in rule_ids],
+                },
+            },
+            "results": results,
+            "properties": {
+                "checked_contracts": list(report.checked_contracts),
+                "skipped": list(report.skipped),
+            },
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
 def sort_findings(findings: list) -> list:
     return sorted(findings, key=lambda f: (f.layer, f.where, f.line, f.rule))
 

@@ -39,6 +39,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 
 from . import metrics as _metrics
 from . import tracing as _tracing
+from ..utils.concurrency import guarded_by
 
 __all__ = [
     "FlightArtifactError", "FlightRecorder", "configure_flight",
@@ -57,6 +58,8 @@ class FlightArtifactError(RuntimeError):
     """A flight artifact failed its frame checks (magic/version/CRC)."""
 
 
+@guarded_by("_lock", fields=["_spans", "_counters", "_active",
+                             "_dump_paths", "_seq"])
 class FlightRecorder:
     """Bounded in-memory ring + one-shot post-mortem dumps."""
 

@@ -43,6 +43,8 @@ import threading
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Protocol, \
     Sequence, Tuple, runtime_checkable
 
+from ..utils.concurrency import acquire_in_order, guarded_by
+
 __all__ = [
     "Counter", "CounterSource", "Gauge", "Histogram", "MetricsRegistry",
     "format_table", "get_registry", "record_decode_stats",
@@ -77,6 +79,7 @@ def _label_str(key: LabelKey) -> str:
                           for k, v in key) + "}"
 
 
+@guarded_by("_lock", fields=["_values"])
 class _Metric:
     """Shared name/help/values plumbing; subclasses define the semantics."""
 
@@ -130,6 +133,7 @@ class Gauge(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
 
+@guarded_by("_lock", fields=["_counts", "count", "sum", "_min", "_max"])
 class Histogram:
     """Log-spaced fixed-bucket histogram with interpolated quantiles.
 
@@ -215,7 +219,10 @@ class Histogram:
         (used to publish a call-private observer into the registry)."""
         if other.edges != self.edges:
             raise ValueError(f"cannot merge {other.name}: bucket edges differ")
-        with self._lock, other._lock:
+        # id()-ordered acquisition: A.merge_from(B) racing B.merge_from(A)
+        # takes the pair in the same global order on both threads, so the
+        # source-order ABBA deadlock (threadlint EG102) cannot happen
+        with acquire_in_order(self._lock, other._lock):
             for b, c in enumerate(other._counts):
                 self._counts[b] += c
             self.count += other.count
@@ -260,6 +267,7 @@ class CounterSource(Protocol):
         ...
 
 
+@guarded_by("_lock", fields=["_metrics"])
 class MetricsRegistry:
     """Process-wide named metric store. ``enabled`` gates every adapter (and
     should gate ad-hoc recording too); metric creation is get-or-create so
@@ -303,9 +311,16 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    def _items(self) -> List[Tuple[str, Any]]:
+        """Consistent name->metric view; per-metric state is read under
+        each metric's own lock *after* the registry lock is released (no
+        nested acquisition, no torn scrape on a concurrent clear())."""
+        with self._lock:
+            return sorted(self._metrics.items())
+
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able ``{name: {kind, help, values|percentiles}}``."""
-        return {name: self._metrics[name].snapshot() for name in self.names()}
+        return {name: m.snapshot() for name, m in self._items()}
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
@@ -320,8 +335,7 @@ class MetricsRegistry:
         / ``_sum`` / ``_count`` already share one family header)."""
         lines: List[str] = []
         emitted_headers: set = set()
-        for name in self.names():
-            m = self._metrics[name]
+        for name, m in self._items():
             if name not in emitted_headers:
                 emitted_headers.add(name)
                 if m.help:

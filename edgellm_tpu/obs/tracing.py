@@ -32,6 +32,7 @@ import warnings
 from typing import Any, Callable, Dict, Iterator, List, Optional
 
 from . import context as _context
+from ..utils.concurrency import guarded_by
 
 __all__ = [
     "Span", "Tracer", "configure", "get_tracer", "span", "trace_capture",
@@ -71,6 +72,7 @@ def _jax_annotation(name: str) -> contextlib.AbstractContextManager:
         return contextlib.nullcontext()
 
 
+@guarded_by("_lock", fields=["_spans"])
 class Tracer:
     """Collects spans process-wide; one instance behind :func:`get_tracer`."""
 

@@ -49,6 +49,7 @@ from __future__ import annotations
 
 import functools
 import os
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -64,6 +65,7 @@ from ..models.paged_kv import OutOfPages, OutOfSlots, PagedKVCache, \
 from ..obs import context as obs_context
 from ..obs.flight import flight_dump_for
 from ..obs.tracing import span as obs_span
+from ..utils.concurrency import guarded_by
 from .decode import _prefill_jit, _sample
 from .recovery import CheckpointError, DecodeCheckpoint, Watchdog
 
@@ -175,6 +177,7 @@ def batched_step_cache_size() -> int:
 _split_sample_jit = jax.jit(_batched_sample)
 
 
+@guarded_by("_stats_lock", fields=["stats"])
 class ContinuousBatcher:
     """Admit/evict streams mid-flight into one compiled ragged decode step.
 
@@ -235,7 +238,9 @@ class ContinuousBatcher:
         self._watchdog = (Watchdog(self.bcfg.step_deadline_s)
                           if self.bcfg.step_deadline_s is not None else None)
         # running aggregates only — a long-lived server takes millions of
-        # steps, so no per-step sample lists
+        # steps, so no per-step sample lists; the obs scrape thread reads
+        # report() mid-step, so every write holds _stats_lock
+        self._stats_lock = threading.Lock()
         self.stats = {"steps": 0, "submitted": 0, "admitted": 0, "evicted": 0,
                       "finished": 0, "jit_misses": 0, "emitted_tokens": 0,
                       "prefill_s": 0.0, "decode_s": 0.0,
@@ -267,7 +272,8 @@ class ContinuousBatcher:
         self._streams[sid] = Stream(sid, prompt, int(max_new_tokens),
                                     float(temperature), int(rng_seed))
         self._waiting.append(sid)
-        self.stats["submitted"] += 1
+        with self._stats_lock:
+            self.stats["submitted"] += 1
         with obs_span("batch.submit", sid=sid, prompt_len=int(prompt.size),
                       max_new_tokens=int(max_new_tokens)):
             pass
@@ -367,12 +373,14 @@ class ContinuousBatcher:
                 self.pool.adopt(slot, cache.k[:, 0, :s], cache.v[:, 0, :s],
                                 s)
             st.tokens.append(int(np.asarray(tok0)[0]))
-        self.stats["prefill_s"] += time.monotonic() - t0
+        with self._stats_lock:
+            self.stats["prefill_s"] += time.monotonic() - t0
         st.status, st.slot = "running", slot
         st.admit_seq = self._admit_seq
         self._admit_seq += 1
         self._slot_to_sid[slot] = sid
-        self.stats["admitted"] += 1
+        with self._stats_lock:
+            self.stats["admitted"] += 1
         with obs_span("batch.admit", sid=sid, slot=slot,
                       microbatch=self._microbatch_of(slot), resumed=resumed):
             pass
@@ -408,7 +416,8 @@ class ContinuousBatcher:
         st.status, st.slot = "waiting", -1
         st.evictions += 1
         self._waiting.appendleft(sid)  # resumed work goes to the head
-        self.stats["evicted"] += 1
+        with self._stats_lock:
+            self.stats["evicted"] += 1
         if self.bcfg.checkpoint_dir is not None:
             # bound so the checkpoint-save span carries the stream id
             with obs_context.bind(sid=sid):
@@ -432,8 +441,9 @@ class ContinuousBatcher:
         self.pool.free_slot(st.slot)
         del self._slot_to_sid[st.slot]
         st.status, st.slot = "finished", -1
-        self.stats["finished"] += 1
-        self.stats["emitted_tokens"] += len(st.tokens)
+        with self._stats_lock:
+            self.stats["finished"] += 1
+            self.stats["emitted_tokens"] += len(st.tokens)
 
     # -- the ragged step ---------------------------------------------------
 
@@ -522,10 +532,13 @@ class ContinuousBatcher:
             self.pool.pool = type(self.pool.pool)(k, v)
         toks_host = np.asarray(toks)  # ONE host sync per step
         step_s = time.monotonic() - t0
-        self.stats["decode_s"] += step_s
-        self.stats["jit_misses"] += self._step_cache_size() - misses0
-        self.stats["steps"] += 1
-        with obs_span("batch.step", step=int(self.stats["steps"]) - 1,
+        misses = self._step_cache_size() - misses0
+        with self._stats_lock:
+            self.stats["decode_s"] += step_s
+            self.stats["jit_misses"] += misses
+            self.stats["steps"] += 1
+            step_no = int(self.stats["steps"]) - 1
+        with obs_span("batch.step", step=step_no,
                       running=len(running), step_ms=round(step_s * 1e3, 3)):
             pass
 
@@ -539,17 +552,20 @@ class ContinuousBatcher:
             if st.t >= st.max_new_tokens:
                 self._finish(st)
         occ = self.pool.live_tokens / self.pool.token_capacity
-        self.stats["occ_sum"] += occ
-        self.stats["occ_max"] = max(self.stats["occ_max"], occ)
-        self.stats["slot_sum"] += len(self._slot_to_sid) / b
+        slot_util = len(self._slot_to_sid) / b
         # live tokens per RESERVED token — the denominator is only the pages
         # actually allocated, the paged answer to static batching's
         # worst-case (batch x capacity) reservation
         reserved = (self.pool.num_pages - 1
                     - self.pool.num_free_pages) * self.pool.page_size
-        if reserved:
-            self.stats["alloc_sum"] += self.pool.live_tokens / reserved
-            self.stats["alloc_n"] += 1
+        alloc_util = self.pool.live_tokens / reserved if reserved else None
+        with self._stats_lock:
+            self.stats["occ_sum"] += occ
+            self.stats["occ_max"] = max(self.stats["occ_max"], occ)
+            self.stats["slot_sum"] += slot_util
+            if alloc_util is not None:
+                self.stats["alloc_sum"] += alloc_util
+                self.stats["alloc_n"] += 1
         if self._watchdog is not None:
             self._watchdog.check()
         return advanced
@@ -651,27 +667,29 @@ class ContinuousBatcher:
     # -- reporting ---------------------------------------------------------
 
     def report(self) -> dict:
-        n = self.stats["steps"]
-        alloc_n = self.stats["alloc_n"]
-        dec = self.stats["decode_s"]
-        emitted = self.stats["emitted_tokens"]
+        with self._stats_lock:
+            stats = dict(self.stats)  # one consistent snapshot for the scrape
+        n = stats["steps"]
+        alloc_n = stats["alloc_n"]
+        dec = stats["decode_s"]
+        emitted = stats["emitted_tokens"]
         pipeline = (self.rt.pipeline_summary()
                     if getattr(self.rt, "pipelined", False) else None)
         return {
             **({"pipeline": pipeline} if pipeline is not None else {}),
-            "streams": self.stats["submitted"],
-            "finished": self.stats["finished"],
+            "streams": stats["submitted"],
+            "finished": stats["finished"],
             "steps": n,
-            "admitted": self.stats["admitted"],
-            "evicted": self.stats["evicted"],
-            "jit_misses": self.stats["jit_misses"],
-            "prefill_s": self.stats["prefill_s"],
+            "admitted": stats["admitted"],
+            "evicted": stats["evicted"],
+            "jit_misses": stats["jit_misses"],
+            "prefill_s": stats["prefill_s"],
             "decode_s": dec,
             "decode_tokens_per_s": (emitted / dec) if dec > 0 else 0.0,
-            "occupancy_mean": (self.stats["occ_sum"] / n) if n else 0.0,
-            "occupancy_max": self.stats["occ_max"],
-            "slot_util_mean": (self.stats["slot_sum"] / n) if n else 0.0,
-            "alloc_util_mean": ((self.stats["alloc_sum"] / alloc_n)
+            "occupancy_mean": (stats["occ_sum"] / n) if n else 0.0,
+            "occupancy_max": stats["occ_max"],
+            "slot_util_mean": (stats["slot_sum"] / n) if n else 0.0,
+            "alloc_util_mean": ((stats["alloc_sum"] / alloc_n)
                                 if alloc_n else 0.0),
             "span": self.bcfg.span,
             "token_capacity": self.pool.token_capacity,

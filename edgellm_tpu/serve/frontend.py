@@ -62,6 +62,7 @@ from ..obs.metrics import get_registry
 from ..obs.server import ObsServer
 from ..obs.tracing import span as obs_span
 from ..utils.clock import MONOTONIC, Clock
+from ..utils.concurrency import guarded_by
 from .decode import generate, generate_split
 from .overload import (COMPLETED, FAILED, FAILED_OVER, REJECTED, SHED,
                        TIMED_OUT, AdmissionController, AdmissionError,
@@ -210,6 +211,7 @@ def _round_up(n: int, quantum: int) -> int:
     return ((n + quantum - 1) // quantum) * quantum
 
 
+@guarded_by("_submit_lock", fields=["_seq", "_queue", "_backlog_s"])
 class ServeFront:
     """The serving front. One instance owns the queue, the controllers, the
     breakers, and (optionally) a split runtime; ``submit`` admits,
@@ -399,14 +401,25 @@ class ServeFront:
 
     # -- drain -------------------------------------------------------------
 
+    def _pop_pending(self) -> Optional[_Pending]:
+        """Pop the highest-priority pending request and re-price the
+        backlog, atomically w.r.t. concurrent submitters (None when the
+        queue is empty). Execution stays outside the lock."""
+        with self._submit_lock:
+            if not self._queue:
+                return None
+            _, _, _, pend = heapq.heappop(self._queue)
+            self._backlog_s = max(0.0, self._backlog_s - pend.est_s)
+            return pend
+
     def drain(self, max_requests: Optional[int] = None) -> list:
         """Execute queued requests in (priority, deadline) order; returns
         the records produced by this call."""
-        out = []
-        while self._queue and (max_requests is None
-                               or len(out) < max_requests):
-            _, _, _, pend = heapq.heappop(self._queue)
-            self._backlog_s = max(0.0, self._backlog_s - pend.est_s)
+        out: list = []
+        while max_requests is None or len(out) < max_requests:
+            pend = self._pop_pending()
+            if pend is None:
+                break
             self.brownout.observe(len(self._queue)
                                   / self.admission.cfg.max_queue_depth)
             out.append(self._execute(pend))
@@ -431,10 +444,11 @@ class ServeFront:
                 "ServeFront(..., batcher=ContinuousBatcher(...))")
         out: list = []
         inflight: dict = {}   # sid -> (pend, queue_wait_s, started_at)
-        while self._queue and (max_requests is None
-                               or len(out) + len(inflight) < max_requests):
-            _, _, _, pend = heapq.heappop(self._queue)
-            self._backlog_s = max(0.0, self._backlog_s - pend.est_s)
+        while (max_requests is None
+               or len(out) + len(inflight) < max_requests):
+            pend = self._pop_pending()
+            if pend is None:
+                break
             self.brownout.observe(len(self._queue)
                                   / self.admission.cfg.max_queue_depth)
             now = self.clock()

@@ -39,13 +39,24 @@ composes — four independent controllers, every one driven by the shared
 Everything here is pure host-side Python — no jax import, no graph residue
 (the frontend's graphlint identity contract proves the composed front traces
 the exact ``generate`` decode step).
+
+Thread-safety: every controller is read on the obs scrape thread
+(``health_summary`` / ``/snapshot.json``) while the decode thread mutates
+it, so each owns a ``threading.Lock`` declared via ``@guarded_by``
+(threadlint EG101 enforces the discipline package-wide). Public methods
+take the lock; ``*_locked`` helpers assume it is held. Properties with
+read-side state transitions (``CircuitBreaker.state`` lazily arming
+half-open probes, ``RetryBudget.available`` refilling the bucket) are the
+reason reads lock too — a scrape used to race those transitions.
 """
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Optional
 
 from ..utils.clock import MONOTONIC, Clock
+from ..utils.concurrency import guarded_by
 
 __all__ = [
     "COMPLETED", "REJECTED", "SHED", "TIMED_OUT", "FAILED_OVER", "FAILED",
@@ -171,6 +182,9 @@ class AdmissionConfig:
                              f"got {self.ewma_alpha!r}")
 
 
+@guarded_by("_lock", fields=["_prefill_s_tok", "_decode_s_tok", "admitted",
+                             "rejected_queue_full", "rejected_deadline",
+                             "measurements"])
 class AdmissionController:
     """Prices requests with a measured latency model and refuses infeasible
     or over-capacity work with typed errors.
@@ -183,6 +197,7 @@ class AdmissionController:
 
     def __init__(self, config: Optional[AdmissionConfig] = None):
         self.cfg = config if config is not None else AdmissionConfig()
+        self._lock = threading.Lock()
         self._prefill_s_tok = self.cfg.init_prefill_s_per_token
         self._decode_s_tok = self.cfg.init_decode_s_per_token
         self.admitted = 0
@@ -190,57 +205,74 @@ class AdmissionController:
         self.rejected_deadline = 0
         self.measurements = 0
 
-    def estimate_s(self, prompt_tokens: int, new_tokens: int) -> float:
-        """Priced service time for one request at the current EWMA rates."""
+    def _estimate_s_locked(self, prompt_tokens: int, new_tokens: int) -> float:
         return (prompt_tokens * self._prefill_s_tok
                 + new_tokens * self._decode_s_tok)
+
+    def estimate_s(self, prompt_tokens: int, new_tokens: int) -> float:
+        """Priced service time for one request at the current EWMA rates."""
+        with self._lock:
+            return self._estimate_s_locked(prompt_tokens, new_tokens)
+
+    def _feasible_locked(self, prompt_tokens: int, new_tokens: int,
+                         deadline_s: Optional[float],
+                         backlog_s: float) -> bool:
+        if deadline_s is None:
+            return True
+        est = backlog_s + self._estimate_s_locked(prompt_tokens, new_tokens)
+        return est * self.cfg.safety_factor <= deadline_s
 
     def feasible(self, prompt_tokens: int, new_tokens: int,
                  deadline_s: Optional[float],
                  backlog_s: float = 0.0) -> bool:
         """Whether queue backlog + priced service time fits the deadline."""
-        if deadline_s is None:
-            return True
-        est = backlog_s + self.estimate_s(prompt_tokens, new_tokens)
-        return est * self.cfg.safety_factor <= deadline_s
+        with self._lock:
+            return self._feasible_locked(prompt_tokens, new_tokens,
+                                         deadline_s, backlog_s)
 
     def admit(self, prompt_tokens: int, new_tokens: int,
               queue_depth: int, deadline_s: Optional[float],
               backlog_s: float = 0.0) -> None:
         """Raise the typed refusal, or count the admission."""
-        if queue_depth >= self.cfg.max_queue_depth:
-            self.rejected_queue_full += 1
-            raise QueueFull(
-                f"queue at capacity ({queue_depth}/{self.cfg.max_queue_depth})")
-        if not self.feasible(prompt_tokens, new_tokens, deadline_s, backlog_s):
-            self.rejected_deadline += 1
-            est = backlog_s + self.estimate_s(prompt_tokens, new_tokens)
-            raise DeadlineInfeasible(
-                f"estimated {est:.3f}s (x{self.cfg.safety_factor:g} safety) "
-                f"cannot fit the {deadline_s:g}s deadline")
-        self.admitted += 1
+        with self._lock:
+            if queue_depth >= self.cfg.max_queue_depth:
+                self.rejected_queue_full += 1
+                raise QueueFull(
+                    f"queue at capacity "
+                    f"({queue_depth}/{self.cfg.max_queue_depth})")
+            if not self._feasible_locked(prompt_tokens, new_tokens,
+                                         deadline_s, backlog_s):
+                self.rejected_deadline += 1
+                est = backlog_s + self._estimate_s_locked(prompt_tokens,
+                                                          new_tokens)
+                raise DeadlineInfeasible(
+                    f"estimated {est:.3f}s (x{self.cfg.safety_factor:g} "
+                    f"safety) cannot fit the {deadline_s:g}s deadline")
+            self.admitted += 1
 
     def record(self, prompt_tokens: int, prefill_s: float,
                decode_steps: int, decode_s: float) -> None:
         """Fold one generation's measured walls into the EWMA price."""
         a = self.cfg.ewma_alpha
-        if prompt_tokens > 0 and prefill_s > 0:
-            self._prefill_s_tok += a * (prefill_s / prompt_tokens
-                                        - self._prefill_s_tok)
-        if decode_steps > 0 and decode_s > 0:
-            self._decode_s_tok += a * (decode_s / decode_steps
-                                       - self._decode_s_tok)
-        self.measurements += 1
+        with self._lock:
+            if prompt_tokens > 0 and prefill_s > 0:
+                self._prefill_s_tok += a * (prefill_s / prompt_tokens
+                                            - self._prefill_s_tok)
+            if decode_steps > 0 and decode_s > 0:
+                self._decode_s_tok += a * (decode_s / decode_steps
+                                           - self._decode_s_tok)
+            self.measurements += 1
 
     def summary(self) -> dict:
-        return {
-            "admitted": self.admitted,
-            "rejected_queue_full": self.rejected_queue_full,
-            "rejected_deadline": self.rejected_deadline,
-            "measurements": self.measurements,
-            "prefill_s_per_token": self._prefill_s_tok,
-            "decode_s_per_token": self._decode_s_tok,
-        }
+        with self._lock:
+            return {
+                "admitted": self.admitted,
+                "rejected_queue_full": self.rejected_queue_full,
+                "rejected_deadline": self.rejected_deadline,
+                "measurements": self.measurements,
+                "prefill_s_per_token": self._prefill_s_tok,
+                "decode_s_per_token": self._decode_s_tok,
+            }
 
 
 # ---------------------------------------------------------------------------
@@ -268,6 +300,7 @@ class RetryBudgetConfig:
                              f"got {self.refill_per_s!r}")
 
 
+@guarded_by("_lock", fields=["_level", "_last", "spent", "denied"])
 class RetryBudget:
     """Meters ladder retries across every request the front serves.
 
@@ -284,12 +317,13 @@ class RetryBudget:
                  clock: Clock = MONOTONIC):
         self.cfg = config if config is not None else RetryBudgetConfig()
         self.clock = clock
+        self._lock = threading.Lock()
         self._level = float(self.cfg.capacity)
         self._last: Optional[float] = None
         self.spent = 0
         self.denied = 0
 
-    def _refill(self) -> None:
+    def _refill_locked(self) -> None:
         now = self.clock()
         if self._last is not None and self.cfg.refill_per_s > 0:
             self._level = min(float(self.cfg.capacity),
@@ -300,8 +334,9 @@ class RetryBudget:
     @property
     def available(self) -> float:
         """Retries the bucket will currently fund (floored at 0)."""
-        self._refill()
-        return max(self._level, 0.0)
+        with self._lock:
+            self._refill_locked()
+            return max(self._level, 0.0)
 
     def exhausted(self) -> bool:
         return self.available < 1.0
@@ -312,22 +347,26 @@ class RetryBudget:
             raise ValueError(f"cannot charge {retries} retries")
         if retries == 0:
             return
-        self._refill()
-        self._level -= retries
-        self.spent += int(retries)
+        with self._lock:
+            self._refill_locked()
+            self._level -= retries
+            self.spent += int(retries)
 
     def deny(self) -> None:
         """Count a routing refusal caused by an empty bucket."""
-        self.denied += 1
+        with self._lock:
+            self.denied += 1
 
     def summary(self) -> dict:
-        return {
-            "capacity": self.cfg.capacity,
-            "refill_per_s": self.cfg.refill_per_s,
-            "available": self.available,
-            "spent": self.spent,
-            "denied": self.denied,
-        }
+        with self._lock:
+            self._refill_locked()
+            return {
+                "capacity": self.cfg.capacity,
+                "refill_per_s": self.cfg.refill_per_s,
+                "available": max(self._level, 0.0),
+                "spent": self.spent,
+                "denied": self.denied,
+            }
 
 
 # ---------------------------------------------------------------------------
@@ -363,6 +402,8 @@ class BreakerConfig:
                 raise ValueError(f"{f} must be a number > 0, got {v!r}")
 
 
+@guarded_by("_lock", fields=["_state", "_failures", "_opened_at", "_probes",
+                             "opens", "total_failures"])
 class CircuitBreaker:
     """One guarded resource (a stage, a link, a whole backend).
 
@@ -377,6 +418,7 @@ class CircuitBreaker:
         self.name = name
         self.cfg = config if config is not None else BreakerConfig()
         self.clock = clock
+        self._lock = threading.Lock()
         self._state = CLOSED
         self._failures = 0
         self._opened_at: Optional[float] = None
@@ -384,48 +426,60 @@ class CircuitBreaker:
         self.opens = 0
         self.total_failures = 0
 
-    @property
-    def state(self) -> str:
-        """Current state; lazily transitions open -> half-open on the clock
-        (there is no background thread to do it eagerly)."""
-        if (self._state == OPEN
+    def _state_locked(self) -> str:
+        """The open -> half-open clock transition; caller holds the lock.
+        The scrape thread calls this through :meth:`summary` concurrently
+        with decode-thread ``allow``/``record_failure`` — the transition
+        mutating ``_state``/``_probes`` is exactly why reads lock."""
+        if (self._state == OPEN and self._opened_at is not None
                 and self.clock() - self._opened_at >= self.cfg.reset_timeout_s):
             self._state = HALF_OPEN
             self._probes = self.cfg.half_open_probes
         return self._state
 
+    @property
+    def state(self) -> str:
+        """Current state; lazily transitions open -> half-open on the clock
+        (there is no background thread to do it eagerly)."""
+        with self._lock:
+            return self._state_locked()
+
     def allow(self) -> bool:
         """May a request pass right now? Half-open passes consume a probe."""
-        s = self.state
-        if s == CLOSED:
-            return True
-        if s == HALF_OPEN and self._probes > 0:
-            self._probes -= 1
-            return True
-        return False
+        with self._lock:
+            s = self._state_locked()
+            if s == CLOSED:
+                return True
+            if s == HALF_OPEN and self._probes > 0:
+                self._probes -= 1
+                return True
+            return False
 
     def record_success(self) -> None:
-        if self.state == HALF_OPEN:
-            self._state = CLOSED
-        self._failures = 0
+        with self._lock:
+            if self._state_locked() == HALF_OPEN:
+                self._state = CLOSED
+            self._failures = 0
 
     def record_failure(self) -> None:
-        self.total_failures += 1
-        s = self.state
-        if s == HALF_OPEN:
-            self._open()
-            return
-        if s == CLOSED:
-            self._failures += 1
-            if self._failures >= self.cfg.failure_threshold:
-                self._open()
+        with self._lock:
+            self.total_failures += 1
+            s = self._state_locked()
+            if s == HALF_OPEN:
+                self._open_locked()
+                return
+            if s == CLOSED:
+                self._failures += 1
+                if self._failures >= self.cfg.failure_threshold:
+                    self._open_locked()
 
     def trip(self) -> None:
         """Open unconditionally (a stage marked dead needs no vote)."""
-        if self.state != OPEN:
-            self._open()
+        with self._lock:
+            if self._state_locked() != OPEN:
+                self._open_locked()
 
-    def _open(self) -> None:
+    def _open_locked(self) -> None:
         self._state = OPEN
         self._opened_at = self.clock()
         self._failures = 0
@@ -439,9 +493,10 @@ class CircuitBreaker:
             self.record_success()
 
     def summary(self) -> dict:
-        return {"state": self.state, "opens": self.opens,
-                "consecutive_failures": self._failures,
-                "total_failures": self.total_failures}
+        with self._lock:
+            return {"state": self._state_locked(), "opens": self.opens,
+                    "consecutive_failures": self._failures,
+                    "total_failures": self.total_failures}
 
 
 # ---------------------------------------------------------------------------
@@ -504,6 +559,8 @@ class BrownoutConfig:
                              f"got {self.shed_below_priority!r}")
 
 
+@guarded_by("_lock", fields=["level", "switches", "observations", "sheds",
+                             "_last_switch"])
 class BrownoutController:
     """Walks the brownout ladder one level per dwell as load crosses the
     hysteresis band; the front consults the properties on every dispatch.
@@ -518,6 +575,7 @@ class BrownoutController:
                  clock: Clock = MONOTONIC):
         self.cfg = config if config is not None else BrownoutConfig()
         self.clock = clock
+        self._lock = threading.Lock()
         self.level = 0
         self.switches = 0
         self.observations = 0
@@ -526,20 +584,22 @@ class BrownoutController:
 
     def observe(self, load: float) -> int:
         """Fold one load reading (queue fullness in [0, 1]) into the level."""
-        self.observations += 1
-        now = self.clock()
-        dwell_ok = (self._last_switch is None
-                    or now - self._last_switch >= self.cfg.min_dwell_s)
-        if (load >= self.cfg.degrade_load and dwell_ok
-                and self.level < self.cfg.max_level):
-            self.level += 1
-            self.switches += 1
-            self._last_switch = now
-        elif load <= self.cfg.promote_load and dwell_ok and self.level > 0:
-            self.level -= 1
-            self.switches += 1
-            self._last_switch = now
-        return self.level
+        with self._lock:
+            self.observations += 1
+            now = self.clock()
+            dwell_ok = (self._last_switch is None
+                        or now - self._last_switch >= self.cfg.min_dwell_s)
+            if (load >= self.cfg.degrade_load and dwell_ok
+                    and self.level < self.cfg.max_level):
+                self.level += 1
+                self.switches += 1
+                self._last_switch = now
+            elif (load <= self.cfg.promote_load and dwell_ok
+                  and self.level > 0):
+                self.level -= 1
+                self.switches += 1
+                self._last_switch = now
+            return self.level
 
     # -- what the current level turns off ---------------------------------
 
@@ -565,12 +625,15 @@ class BrownoutController:
 
     def should_shed(self, priority: int) -> bool:
         """At the shed level, drop requests below the priority floor."""
-        if self.level >= 4 and priority < self.cfg.shed_below_priority:
-            self.sheds += 1
-            return True
-        return False
+        with self._lock:
+            if self.level >= 4 and priority < self.cfg.shed_below_priority:
+                self.sheds += 1
+                return True
+            return False
 
     def summary(self) -> dict:
-        return {"level": self.level, "mode": self.mode,
-                "switches": self.switches, "observations": self.observations,
-                "sheds": self.sheds}
+        with self._lock:
+            return {"level": self.level, "mode": BROWNOUT_LEVELS[self.level],
+                    "switches": self.switches,
+                    "observations": self.observations,
+                    "sheds": self.sheds}

@@ -1,0 +1,69 @@
+"""Concurrency contracts for the host serve plane.
+
+Two tiny primitives that threadlint (``edgellm_tpu/lint/threadlint.py``,
+rules EG101-EG104) keys off:
+
+- ``@guarded_by("_lock", fields=[...])`` declares which attributes of a
+  class may only be written while ``self._lock`` is held.  The decorator
+  is metadata-only (zero runtime cost); the static analyzer enforces it
+  package-wide, and classes that merely own a ``threading.Lock`` are
+  auto-discovered even without the decorator.
+- ``acquire_in_order(*locks)`` acquires several locks in a single global
+  deterministic order (ascending ``id()``), which makes symmetric
+  multi-instance critical sections (A.merge_from(B) racing
+  B.merge_from(A)) deadlock-free.  threadlint treats a ``with
+  acquire_in_order(...)`` block as one atomic, correctly-ordered
+  acquisition and never raises EG102 for it.
+
+Stdlib-only: the obs/ and serve/ modules import this and must stay
+importable without jax.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Sequence, Tuple
+
+__all__ = ["guarded_by", "acquire_in_order"]
+
+
+def guarded_by(lock_attr: str, *, fields: Sequence[str]) -> Callable[[type], type]:
+    """Class decorator declaring a lock-discipline contract.
+
+    ``@guarded_by("_lock", fields=["count", "_values"])`` means: every
+    write to ``self.count`` / ``self._values`` outside ``__init__`` must
+    happen inside a ``with self._lock`` (or ``acquire_in_order``) block.
+    Enforced statically by graphlint rule EG101; at runtime this only
+    attaches ``__guarded_by__`` metadata for introspection.
+    """
+    contract: Dict[str, Any] = {"lock": lock_attr, "fields": tuple(fields)}
+
+    def _decorate(cls: type) -> type:
+        setattr(cls, "__guarded_by__", contract)
+        return cls
+
+    return _decorate
+
+
+@contextmanager
+def acquire_in_order(*locks: Any) -> Iterator[None]:
+    """Acquire ``locks`` in ascending ``id()`` order, release in reverse.
+
+    Duplicate lock objects are acquired once (safe for the self-merge
+    ``h.merge_from(h)`` spelling even with non-reentrant locks).  Because
+    every thread sorts by the same global key, two threads taking the
+    same pair of locks can never deadlock on each other — the fix for
+    the EG102 class of bugs (see ``Histogram.merge_from``).
+    """
+    unique: Dict[int, Any] = {}
+    for lock in locks:
+        unique.setdefault(id(lock), lock)
+    ordered: Tuple[Any, ...] = tuple(unique[key] for key in sorted(unique))
+    taken: List[Any] = []
+    try:
+        for lock in ordered:
+            lock.acquire()
+            taken.append(lock)
+        yield
+    finally:
+        for lock in reversed(taken):
+            lock.release()

@@ -1,0 +1,37 @@
+"""EG101 seed: guarded fields written outside ``with self._lock``."""
+import threading
+
+from edgellm_tpu.utils.concurrency import guarded_by
+
+
+@guarded_by("_lock", fields=["balance", "entries"])
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.balance = 0
+        self.entries = []
+
+    def deposit(self, amount):
+        with self._lock:
+            self.balance += amount
+
+    def fast_deposit(self, amount):
+        self.balance += amount  # line 19: declared field, no lock held
+
+    def log(self, entry):
+        self.entries.append(entry)  # line 22: mutator call, no lock held
+
+
+class AutoCounter:
+    """No decorator: the owned Lock + locked writes imply the contract."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+
+    def inc(self):
+        with self._lock:
+            self.total += 1
+
+    def reset(self):
+        self.total = 0  # line 37: written under _lock elsewhere, bare here

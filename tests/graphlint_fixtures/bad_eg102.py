@@ -1,0 +1,19 @@
+"""EG102 seed: inconsistent / hazardous multi-lock acquisition order."""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def merge_from(self, other):
+        # line 13: source-order acquisition of two same-class instance
+        # locks — A.merge_from(B) racing B.merge_from(A) is an ABBA deadlock
+        with self._lock, other._lock:
+            self.items.update(other.items)
+
+    def double_take(self):
+        with self._lock:
+            with self._lock:  # line 18: re-acquire of a non-reentrant lock
+                return dict(self.items)

@@ -1,0 +1,24 @@
+"""EG103 seed: blocking work while holding a lock."""
+import threading
+import time
+
+
+class Dumper:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = []
+
+    def slow_append(self, row):
+        with self._lock:
+            time.sleep(0.1)  # line 13: sleep with the lock held
+            self.rows.append(row)
+
+    def dump(self, path):
+        with self._lock:
+            f = open(path, "w")  # line 18: file I/O with the lock held
+            f.write(str(self.rows))
+            f.close()
+
+    def sync(self, array):
+        with self._lock:
+            array.block_until_ready()  # line 24: device sync under the lock

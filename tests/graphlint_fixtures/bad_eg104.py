@@ -1,0 +1,21 @@
+"""EG104 seed: contextvars tokens reset on a different frame than set."""
+import contextvars
+
+REQUEST_ID = contextvars.ContextVar("request_id", default="")
+
+
+class Session:
+    def begin(self, rid):
+        self._token = REQUEST_ID.set(rid)  # line 9: token parked on self
+
+    def end(self):
+        REQUEST_ID.reset(self._token)
+
+
+def fire_and_forget(rid):
+    REQUEST_ID.set(rid)  # line 16: token discarded, can never be reset
+
+
+def leaky(rid):
+    token = REQUEST_ID.set(rid)  # line 20: set but never reset in frame
+    return token

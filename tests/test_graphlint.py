@@ -86,6 +86,34 @@ def test_suppression_comment_disables_rule():
     assert _rules(lint_source(src_wrong, "t.py")) == {"EG001"}
 
 
+def test_suppression_comment_multi_rule():
+    """Comma-separated disables silence every listed rule and nothing else."""
+    src = (
+        "import jax\nimport jax.numpy as jnp\nimport numpy as np\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return np.sqrt(x)  # graphlint: disable={rules}\n")
+    # the fired rule is listed (alongside another): suppressed
+    assert lint_source(src.format(rules="EG001,EG003"), "t.py") == []
+    # listed rules don't include the fired one: still flagged
+    assert _rules(lint_source(src.format(rules="EG001,EG002"), "t.py")) \
+        == {"EG003"}
+    # whitespace around the comma is tolerated
+    assert lint_source(src.format(rules="EG003, EG001"), "t.py") == []
+
+
+def test_collect_suppressions_inventory(tmp_path):
+    from edgellm_tpu.lint.ast_rules import collect_suppressions
+
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "x = 1  # graphlint: disable=EG001,EG003\n"
+        "y = 2\n"
+        "z = 3  # graphlint: disable\n")
+    marks = collect_suppressions([str(p)])
+    assert marks == [(str(p), 1, {"EG001", "EG003"}), (str(p), 3, None)]
+
+
 def test_unreachable_code_not_flagged():
     """Host-only modules may branch on arrays / print / use numpy freely —
     the rules only fire on jit-reachable functions."""
@@ -268,6 +296,68 @@ def test_cli_nonzero_on_seeded_violations(tmp_path):
 def test_cli_zero_on_clean_paths():
     proc = _run_cli("--ast-only", _fixture("clean.py"))
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_thread_only_on_seeded_fixtures():
+    bad = [_fixture(f"bad_eg10{i}.py") for i in range(1, 5)]
+    proc = _run_cli("--thread-only", *bad)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    for rule in ("EG101", "EG102", "EG103", "EG104"):
+        assert rule in proc.stdout, (rule, proc.stdout)
+
+
+def test_cli_show_suppressed_lists_markers():
+    proc = _run_cli("--thread-only", "--show-suppressed",
+                    _fixture("clean.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "suppressions: 0 marker(s)" in proc.stdout
+    # with a real package walk the audit lists file:line for every marker
+    proc = _run_cli("--thread-only", "--show-suppressed")
+    assert "suppressions:" in proc.stdout, proc.stdout
+
+
+def test_cli_sarif_on_violations(tmp_path):
+    import json
+
+    sarif_path = tmp_path / "out.sarif"
+    proc = _run_cli("--thread-only", "--sarif", str(sarif_path),
+                    _fixture("bad_eg102.py"))
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(sarif_path.read_text())
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graphlint"
+    assert {r["id"] for r in run["tool"]["driver"]["rules"]} == {"EG102"}
+    results = run["results"]
+    assert results and all(r["ruleId"] == "EG102" for r in results)
+    region = results[0]["locations"][0]["physicalLocation"]["region"]
+    assert region["startLine"] > 0
+
+
+def test_cli_sarif_on_clean_paths(tmp_path):
+    import json
+
+    sarif_path = tmp_path / "clean.sarif"
+    proc = _run_cli("--ast-only", "--sarif", str(sarif_path),
+                    _fixture("clean.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(sarif_path.read_text())
+    assert doc["runs"][0]["results"] == []
+
+
+def test_cli_json_report_unchanged_shape(tmp_path):
+    """--json stays byte-compatible: same four keys, same ordering."""
+    import json
+
+    report_path = tmp_path / "r.json"
+    proc = _run_cli("--ast-only", "--json", str(report_path),
+                    _fixture("clean.py"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    text = report_path.read_text()
+    report = json.loads(text)
+    assert list(report) == sorted(report)  # sort_keys=True preserved
+    assert set(report) == {"ok", "findings", "checked_contracts", "skipped"}
+    assert text == json.dumps(report, indent=2, sort_keys=True) + "\n"
 
 
 @pytest.mark.slow

@@ -1,0 +1,410 @@
+"""threadlint coverage: every EG1xx rule catches its seeded fixture, the
+shipped package is lock-discipline clean, and the deterministic-schedule
+harness (lint/schedules.py) proves the Histogram.merge_from ABBA deadlock
+reachable under the old source-order acquisition and absent from the
+bounded interleaving set under the shipped id()-ordered fix.
+
+The static fixtures live in ``tests/graphlint_fixtures/bad_eg10x.py`` and
+are PARSED, never imported (same convention as the EG00x seeds).
+"""
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from edgellm_tpu.lint.schedules import (Scheduler, explore, instrument,
+                                        run_schedule)
+from edgellm_tpu.lint.threadlint import (lint_file, lint_files, lint_package,
+                                         lint_source)
+from edgellm_tpu.obs.flight import FlightRecorder, load_flight
+from edgellm_tpu.obs.metrics import Histogram, MetricsRegistry
+from edgellm_tpu.utils.concurrency import acquire_in_order, guarded_by
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "graphlint_fixtures")
+
+
+def _fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+# ---------------------------------------------------------------------------
+# static layer: each EG1xx rule catches its seeded fixture
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fixture,rule,min_hits", [
+    ("bad_eg101.py", "EG101", 3),  # declared + auto-discovered bare writes
+    ("bad_eg102.py", "EG102", 2),  # cross-instance order + re-acquire
+    ("bad_eg103.py", "EG103", 3),  # sleep / open / block_until_ready held
+    ("bad_eg104.py", "EG104", 4),  # self-stored / foreign / lost / leaked
+])
+def test_thread_rule_catches_fixture(fixture, rule, min_hits):
+    findings = lint_file(_fixture(fixture))
+    hits = [f for f in findings if f.rule == rule]
+    assert len(hits) >= min_hits, \
+        f"{fixture}: expected >= {min_hits} {rule} findings, got {findings}"
+    assert all(f.line > 0 for f in hits)
+    assert all(f.layer == "thread" for f in findings)
+
+
+def test_thread_rules_only_fire_their_own_fixture():
+    """Each seeded fixture trips exactly its own rule — no cross-noise."""
+    for fx, rule in [("bad_eg101.py", "EG101"), ("bad_eg103.py", "EG103"),
+                     ("bad_eg104.py", "EG104")]:
+        rules = {f.rule for f in lint_file(_fixture(fx))}
+        assert rules == {rule}, (fx, rules)
+
+
+def test_real_package_thread_clean():
+    """Acceptance: the shipped package carries no EG1xx violations."""
+    import edgellm_tpu
+    from edgellm_tpu.lint.ast_rules import iter_package_files
+
+    pkg_root = os.path.dirname(os.path.abspath(edgellm_tpu.__file__))
+    findings = lint_files(iter_package_files(pkg_root))
+    assert findings == [], [f.format() for f in findings]
+    assert lint_package(pkg_root) == []
+
+
+def test_suppression_comment_disables_thread_rule():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.x = 0\n"
+        "    def locked(self):\n"
+        "        with self._lock:\n"
+        "            self.x += 1\n"
+        "    def bare(self):\n"
+        "        self.x = 1{sup}\n")
+    assert {f.rule for f in lint_source(src.format(sup=""), "t.py")} \
+        == {"EG101"}
+    sup = "  # graphlint: disable=EG101"
+    assert lint_source(src.format(sup=sup), "t.py") == []
+    # an unrelated rule id does not suppress it
+    wrong = "  # graphlint: disable=EG103"
+    assert {f.rule for f in lint_source(src.format(sup=wrong), "t.py")} \
+        == {"EG101"}
+
+
+def test_clean_locked_class_passes():
+    src = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.x = 0\n"
+        "    def inc(self):\n"
+        "        with self._lock:\n"
+        "            self.x += 1\n"
+        "    def get(self):\n"
+        "        with self._lock:\n"
+        "            return self.x\n")
+    assert lint_source(src, "t.py") == []
+
+
+def test_contextvar_clean_pattern_passes():
+    """set + try/finally reset in the same frame (the obs/context.py bind()
+    shape) is the blessed pattern and must not fire EG104."""
+    src = (
+        "import contextvars\n"
+        "CV = contextvars.ContextVar('cv', default='')\n"
+        "def scoped(v):\n"
+        "    token = CV.set(v)\n"
+        "    try:\n"
+        "        return CV.get()\n"
+        "    finally:\n"
+        "        CV.reset(token)\n")
+    assert lint_source(src, "t.py") == []
+
+
+def test_eg102_fires_on_old_merge_from_shape():
+    """The exact pre-fix metrics.py:218 shape — source-order acquisition of
+    two same-class instance locks — must be flagged."""
+    src = (
+        "import threading\n"
+        "class Histogram:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.count = 0\n"
+        "    def merge_from(self, other):\n"
+        "        with self._lock, other._lock:\n"
+        "            self.count += other.count\n")
+    findings = lint_source(src, "metrics_old.py")
+    assert any(f.rule == "EG102" and f.line == 7 for f in findings), findings
+
+
+def test_shipped_metrics_module_thread_clean():
+    import edgellm_tpu.obs.metrics as m
+
+    assert lint_file(os.path.abspath(m.__file__)) == []
+
+
+def test_guarded_by_metadata():
+    @guarded_by("_lock", fields=["a", "b"])
+    class C:
+        pass
+
+    assert C.__guarded_by__ == {"lock": "_lock", "fields": ("a", "b")}
+    # the shipped contracts are declared where threadlint expects them
+    from edgellm_tpu.obs.metrics import MetricsRegistry as MR
+
+    assert "_metrics" in MR.__guarded_by__["fields"]
+
+
+def test_acquire_in_order_is_id_ordered_and_reentrant_safe():
+    a, b = threading.Lock(), threading.Lock()
+    with acquire_in_order(a, b):
+        assert a.locked() and b.locked()
+    assert not a.locked() and not b.locked()
+    # duplicates are deduped, not double-acquired
+    with acquire_in_order(a, a, b):
+        assert a.locked() and b.locked()
+    assert not a.locked() and not b.locked()
+
+
+# ---------------------------------------------------------------------------
+# dynamic layer: the schedule harness
+# ---------------------------------------------------------------------------
+
+
+def _two_histograms(sched):
+    a = Histogram("a", lo=0.1, hi=10.0, n_buckets=4)
+    b = Histogram("b", lo=0.1, hi=10.0, n_buckets=4)
+    a.observe(1.0)
+    b.observe(2.0)
+    instrument(sched, a)
+    instrument(sched, b)
+    return a, b
+
+
+def _unordered_merge(dst, src):
+    """The pre-fix merge_from: source-order lock acquisition (the EG102
+    seed). Kept here so the deadlock stays demonstrable after the fix."""
+    with dst._lock:
+        with src._lock:
+            dst.count += src.count
+            dst.sum += src.sum
+
+
+def test_harness_finds_prefix_merge_deadlock():
+    """Pre-fix cross-merge deadlocks within the 2-preemption bound, and the
+    found schedule replays deterministically."""
+
+    def scenario(sched):
+        a, b = _two_histograms(sched)
+        return [lambda: _unordered_merge(a, b),
+                lambda: _unordered_merge(b, a)]
+
+    outcomes = explore(scenario, max_preemptions=2)
+    dead = [o for o in outcomes if o.deadlocked]
+    assert dead, "bounded search failed to reach the known ABBA deadlock"
+    first = dead[0]
+    # both workers are stuck on the *other* instance's lock
+    assert set(first.blocked) == {0, 1}
+    assert all(name == "Histogram._lock" for name in first.blocked.values())
+    # replay: the recorded decisions reproduce the deadlock exactly
+    replay = run_schedule(scenario,
+                          decisions=[idx for _, idx in first.choice_points])
+    assert replay.deadlocked
+    assert replay.schedule == first.schedule
+
+
+def test_shipped_merge_from_is_deadlock_free():
+    """Post-fix acceptance: id()-ordered acquisition leaves NO deadlocking
+    schedule in the bounded interleaving set, and every schedule merges
+    conservation-correct totals (5 observations counted across the pair)."""
+
+    def scenario(sched):
+        a, b = _two_histograms(sched)
+
+        def verify():
+            assert a.count + b.count == 5, (a.count, b.count)
+
+        return ([lambda: a.merge_from(b), lambda: b.merge_from(a)], verify)
+
+    outcomes = explore(scenario, max_preemptions=3)
+    assert len(outcomes) > 1  # the bound actually explored interleavings
+    assert not any(o.deadlocked for o in outcomes), \
+        [o.blocked for o in outcomes if o.deadlocked]
+    assert not any(o.errors for o in outcomes), \
+        [o.errors for o in outcomes if o.errors]
+
+
+def test_real_thread_cross_merge_regression():
+    """Satellite regression: real threads hammering A.merge_from(B) against
+    B.merge_from(A) must finish (pre-fix this wedges in milliseconds)."""
+    a = Histogram("a", lo=0.1, hi=10.0, n_buckets=4)
+    b = Histogram("b", lo=0.1, hi=10.0, n_buckets=4)
+    a.observe(1.0)
+    b.observe(2.0)
+    start = threading.Barrier(2)
+
+    def pound(dst, src):
+        start.wait()
+        for _ in range(300):
+            dst.merge_from(src)
+
+    t1 = threading.Thread(target=pound, args=(a, b), daemon=True)
+    t2 = threading.Thread(target=pound, args=(b, a), daemon=True)
+    t1.start()
+    t2.start()
+    t1.join(timeout=30)
+    t2.join(timeout=30)
+    assert not t1.is_alive() and not t2.is_alive(), \
+        "cross-merge deadlocked: ordered acquisition regressed"
+
+
+def test_harness_registry_inc_vs_snapshot():
+    """Concurrent submit-path inc against a /snapshot.json-style scrape:
+    no deadlock, no torn final state, over all bounded interleavings."""
+
+    def scenario(sched):
+        reg = MetricsRegistry(enabled=True)
+        c = reg.counter("tl_sched_total", "seed")
+        instrument(sched, reg)
+        instrument(sched, c)
+        seen = []
+
+        def writer():
+            c.inc()
+            c.inc()
+
+        def scraper():
+            seen.append(reg.snapshot())
+
+        def verify():
+            snap = reg.snapshot()["tl_sched_total"]["values"]
+            total = sum(snap.values()) if isinstance(snap, dict) else snap
+            assert total == 2.0, snap
+            for s in seen:  # mid-run scrapes saw 0, 1 or 2 — never garbage
+                vals = s["tl_sched_total"]["values"]
+                got = sum(vals.values()) if isinstance(vals, dict) else vals
+                assert got in (0.0, 1.0, 2.0), s
+
+        return ([writer, scraper], verify)
+
+    outcomes = explore(scenario, max_preemptions=2)
+    assert not any(o.deadlocked or o.errors for o in outcomes), \
+        [(o.blocked, o.errors) for o in outcomes if not o.ok]
+
+
+def test_harness_flight_append_vs_dump(tmp_path):
+    """Flight-ring append racing a post-mortem dump: every interleaving
+    completes and the artifact passes its CRC frame check."""
+
+    def scenario(sched):
+        rec = FlightRecorder(str(tmp_path), capacity=8)
+        instrument(sched, rec)
+        paths = []
+
+        def appender():
+            rec.note_counters("race", {"n": 1})
+
+        def dumper():
+            paths.append(rec.dump("sched_race"))
+
+        def verify():
+            assert paths and load_flight(paths[-1])["reason"] == "sched_race"
+
+        return ([appender, dumper], verify)
+
+    outcomes = explore(scenario, max_preemptions=2)
+    assert not any(o.deadlocked or o.errors for o in outcomes), \
+        [(o.blocked, o.errors) for o in outcomes if not o.ok]
+
+
+def test_harness_self_deadlock_detected():
+    """Re-acquiring a non-reentrant SchedLock is reported as a worker error
+    (the EG102 re-acquire rule's dynamic twin), not a hang."""
+
+    def scenario(sched):
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        box = instrument(sched, Box())
+
+        def hog():
+            with box._lock:
+                with box._lock:
+                    pass
+
+        return [hog]
+
+    out = run_schedule(scenario)
+    assert not out.deadlocked
+    assert len(out.errors) == 1
+    assert "self-deadlock" in str(out.errors[0][1])
+
+
+# ---------------------------------------------------------------------------
+# satellite: live scrape under concurrent writes never tears
+# ---------------------------------------------------------------------------
+
+
+def test_concurrent_scrape_never_tears():
+    """N scraper threads hammering /metrics + /snapshot.json against a hot
+    writer: the exposition parses every time, the watched counter is
+    monotone per scraper, and every snapshot is valid JSON."""
+    from edgellm_tpu.obs.server import ObsServer
+
+    reg = MetricsRegistry(enabled=True)
+    counter = reg.counter("tl_scrape_total", "writer progress")
+    hist = reg.histogram("tl_scrape_seconds", "writer latencies",
+                         lo=1e-4, hi=10.0, n_buckets=16)
+    counter.inc()  # seed so the first scrape always has a sample line
+    hist.observe(1e-3)
+    srv = ObsServer(port=0, registry=reg)
+    srv.start()
+    stop = threading.Event()
+    failures = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            counter.inc()
+            hist.observe(1e-3 * (1 + i % 7))
+            i += 1
+
+    def scrape(kind):
+        with urllib.request.urlopen(f"{srv.url}{kind}", timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    def scraper():
+        last = -1.0
+        try:
+            for i in range(30):
+                text = scrape("/metrics")
+                value = None
+                for line in text.splitlines():
+                    if not line or line.startswith("#"):
+                        continue
+                    # every sample line must parse: "<series> <float>"
+                    float(line.rsplit(None, 1)[1])
+                    if line.startswith("tl_scrape_total"):
+                        value = float(line.rsplit(None, 1)[1])
+                assert value is not None, "counter missing from exposition"
+                assert value >= last, f"counter went backwards: {value}<{last}"
+                last = value
+                snap = json.loads(scrape("/snapshot.json"))
+                assert "tl_scrape_total" in json.dumps(snap["metrics"])
+        except Exception as e:  # noqa: BLE001 - surfaced via failures
+            failures.append(e)
+
+    w = threading.Thread(target=writer, daemon=True)
+    scrapers = [threading.Thread(target=scraper, daemon=True)
+                for _ in range(4)]
+    w.start()
+    for t in scrapers:
+        t.start()
+    for t in scrapers:
+        t.join(timeout=60)
+    stop.set()
+    w.join(timeout=10)
+    srv.stop()
+    assert not failures, failures
+    assert all(not t.is_alive() for t in scrapers)
