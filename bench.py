@@ -127,6 +127,21 @@ streams / static batch size, default 8), BENCH_SERVE_PAGE_SIZE (default
 pool to the static baseline's reservation), BENCH_SERVE_SEED, plus the
 shared BENCH_MODEL / BENCH_DTYPE.
 
+BENCH_PREFIX=1 switches to the prefix-sharing workload (see
+``prefix_main``): one seeded Poisson trace whose prompts all open with the
+same BENCH_PREFIX_SHARED-token system prompt, served twice at the SAME
+fixed pool geometry — prefix cache off, then on. The artifact asserts
+token parity between the two runs and reports prefill tokens saved, the
+prefix-index hit rate, COW fork count, and peak concurrently-running
+streams per run (the pool is deliberately sized to half the exclusive
+reservation, so the enabled run must admit strictly more concurrent
+streams at the same page budget). Knobs: BENCH_PREFIX_REQUESTS (default
+24), BENCH_PREFIX_RATE (default 8.0), BENCH_PREFIX_PROMPT (default 24),
+BENCH_PREFIX_SHARED (default 16), BENCH_PREFIX_TOKENS (default 8),
+BENCH_PREFIX_SLOTS (default 6), BENCH_PREFIX_PAGE_SIZE (default 8),
+BENCH_PREFIX_PAGES, BENCH_PREFIX_SEED, plus the shared BENCH_MODEL /
+BENCH_DTYPE.
+
 BENCH_WIRE=1 switches to the fused boundary-hop workload (see
 ``wire_main``): every FUSED_CAPABLE codec crosses a real 2-stage boundary
 through the fused single-buffer wire hop AND the separate
@@ -1510,6 +1525,148 @@ def serve_main():
     _emit(line, detail)
 
 
+def prefix_main():
+    """BENCH_PREFIX=1: prefix-sharing paged KV cache, same load off vs on.
+
+    ONE seeded Poisson arrival trace where every prompt opens with the same
+    ``BENCH_PREFIX_SHARED``-token system prompt, served twice through the
+    continuous batcher at the SAME fixed pool geometry: once with the prefix
+    cache disabled (every admit prefills its whole prompt) and once enabled
+    (matched pages map in from the radix index, only the suffix prefills,
+    first decode writes fork copy-on-write). Reports:
+
+    - **token parity**: every request's tokens must be identical across the
+      two runs — sharing is a memory/compute optimization, never a numerics
+      change (the CI gate asserts this unconditionally);
+    - **prefill tokens saved**: positions the enabled run never prefilled
+      (the pool's ``saved_tokens`` counter), absolute and as a fraction of
+      all submitted prompt tokens;
+    - **admitted capacity**: peak concurrently-running streams per run. The
+      pool is sized so exclusive prompts bound concurrency; shared pages
+      cover k streams with one physical copy, so the enabled run must peak
+      strictly higher at the same page budget.
+
+    Knobs: BENCH_PREFIX_REQUESTS (default 24), BENCH_PREFIX_RATE (virtual
+    arrivals/s, default 8.0 — saturating, so peak concurrency is pool-bound
+    rather than arrival-bound), BENCH_PREFIX_PROMPT (total prompt tokens,
+    default 24), BENCH_PREFIX_SHARED (shared opening block, default 16),
+    BENCH_PREFIX_TOKENS (new tokens per request, default 8),
+    BENCH_PREFIX_SLOTS (default 6), BENCH_PREFIX_PAGE_SIZE (default 8),
+    BENCH_PREFIX_PAGES (default sizes the pool to HALF the slots' exclusive
+    reservation, the contended regime sharing relieves), BENCH_PREFIX_SEED,
+    plus the shared BENCH_MODEL / BENCH_DTYPE."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.models.paged_kv import PrefixCacheConfig
+    from edgellm_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    n_requests = int(os.environ.get("BENCH_PREFIX_REQUESTS", "24"))
+    rate = float(os.environ.get("BENCH_PREFIX_RATE", "8.0"))
+    prompt_len = int(os.environ.get("BENCH_PREFIX_PROMPT", "24"))
+    shared_len = int(os.environ.get("BENCH_PREFIX_SHARED", "16"))
+    tokens = int(os.environ.get("BENCH_PREFIX_TOKENS", "8"))
+    slots = int(os.environ.get("BENCH_PREFIX_SLOTS", "6"))
+    page_size = int(os.environ.get("BENCH_PREFIX_PAGE_SIZE", "8"))
+    seed = int(os.environ.get("BENCH_PREFIX_SEED", "0"))
+    if not 0 < shared_len < prompt_len:
+        raise SystemExit("BENCH_PREFIX_SHARED must be in (0, BENCH_PREFIX_"
+                         f"PROMPT={prompt_len}), got {shared_len}")
+
+    span = prompt_len + tokens
+    pages_per_slot = -(-span // page_size)
+    # default pool: half the slots' worst-case exclusive reservation — tight
+    # enough that exclusive prompts can't all be live at once, which is
+    # exactly the regime shared pages relieve
+    num_pages = int(os.environ.get(
+        "BENCH_PREFIX_PAGES", str(1 + (slots * pages_per_slot) // 2)))
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+    shared = rng.integers(1, cfg.vocab_size, size=shared_len)
+    prompts = []
+    for _ in range(n_requests):
+        p = rng.integers(1, cfg.vocab_size, size=prompt_len).astype(np.int32)
+        p[:shared_len] = shared
+        prompts.append(p)
+
+    def drive(prefix_cache):
+        bat = ContinuousBatcher(cfg, params, BatchingConfig(
+            page_size=page_size, num_pages=num_pages, max_slots=slots,
+            pages_per_slot=pages_per_slot, compute_dtype=dtype,
+            prefix_cache=prefix_cache))
+        # warm every executable on a throwaway geometry twin: the full
+        # prefill, the ragged step, and (enabled run) the suffix prefill the
+        # second warm stream's index hit compiles
+        warm = ContinuousBatcher(cfg, params, bat.bcfg)
+        for w in range(2):
+            wp = np.ones((prompt_len,), np.int32)
+            wp[shared_len:] += w  # distinct suffixes, identical prefix
+            warm.submit(wp, 2, rng_seed=w)
+        warm.run()
+        sid_of: dict = {}
+        now, nxt, peak = 0.0, 0, 0
+        while nxt < n_requests or bat._slot_to_sid or bat._waiting:
+            while nxt < n_requests and arrivals[nxt] <= now:
+                sid = bat.submit(prompts[nxt], tokens, rng_seed=seed + nxt)
+                sid_of[sid] = nxt
+                nxt += 1
+            t0 = time.monotonic()
+            advanced = bat.step()
+            dt = time.monotonic() - t0
+            if advanced == 0:
+                if nxt >= n_requests:
+                    raise RuntimeError(
+                        "batcher wedged with no future arrivals")
+                now = max(now, arrivals[nxt])  # idle: jump to next arrival
+                continue
+            now += dt
+            peak = max(peak, len(bat._slot_to_sid))
+        bat.pool.check_invariants()
+        toks = {i: bat.results[sid].tolist() for sid, i in sid_of.items()}
+        return toks, bat.report(), peak
+
+    base_toks, base_rep, base_peak = drive(None)
+    got_toks, rep, peak = drive(PrefixCacheConfig(
+        enabled=True, min_shared_block=page_size))
+    parity = all(got_toks[i] == base_toks[i] for i in range(n_requests))
+    pf = rep["prefix"]
+    total_prompt_tokens = n_requests * prompt_len
+
+    detail = {
+        "requests": n_requests, "rate": rate, "seed": seed,
+        "prompt_len": prompt_len, "shared_len": shared_len,
+        "tokens": tokens, "slots": slots, "page_size": page_size,
+        "num_pages": num_pages, "pages_per_slot": pages_per_slot,
+        "token_parity": parity,
+        "prefix": pf,
+        "peak_concurrent": {"off": base_peak, "on": peak},
+        "batcher_report": rep, "batcher_report_off": base_rep,
+    }
+    line = {
+        "metric": (f"{model_name} prefix sharing ({n_requests} reqs, "
+                   f"{shared_len}/{prompt_len} shared prompt tokens, "
+                   f"{num_pages} pages)"),
+        "value": pf["saved_tokens"],
+        "unit": "prefill token positions saved",
+        "vs_baseline": None,  # the reference has no serving layer at all
+        "token_parity": parity,
+        "prefill_tokens_saved": pf["saved_tokens"],
+        "saved_fraction": round(pf["saved_tokens"] / total_prompt_tokens, 4),
+        "prefix_hit_rate": round(pf["hit_rate"], 4),
+        "cow_forks": pf["cow_forks"],
+        "peak_concurrent_off": base_peak,
+        "peak_concurrent_on": peak,
+        "jit_misses": rep["jit_misses"],
+    }
+    _emit(line, detail)
+
+
 def _open_loop_summary(arrivals, t_submit, t_first, t_done, token_stamps,
                        new_tokens) -> dict:
     """Shared latency/throughput rollup for one serve run on the virtual
@@ -1727,6 +1884,8 @@ def main():
         return _run_section("soak", soak_main)
     if os.environ.get("BENCH_SERVE") == "1":
         return _run_section("serve", serve_main)
+    if os.environ.get("BENCH_PREFIX") == "1":
+        return _run_section("prefix", prefix_main)
     if os.environ.get("BENCH_WIRE") == "1":
         return _run_section("wire", wire_main)
     if os.environ.get("BENCH_SPEC") == "1":

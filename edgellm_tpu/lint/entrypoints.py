@@ -210,6 +210,100 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
         findings.append(_driver_error("batching.decode-step-identity", e))
 
+    # ---- prefix-sharing paged KV: the suffix prefill that backfills only
+    # ---- the unmatched prompt tail (collective-free, donated cache) -----
+    suffix_cache = transformer.init_cache(cfg, BATCH, CAPACITY)
+    suffix_ids = jnp.zeros((BATCH, 4), jnp.int32)
+    run_one("decode.prefill_suffix",
+            lambda p, i, c: serve_decode._prefill_suffix_impl(
+                cfg, p, i, c, None),
+            (params, suffix_ids, suffix_cache),
+            ctx={"donate_min": 2},
+            lowerable=serve_decode._prefill_suffix_jit,
+            lower_args=(cfg, params, suffix_ids, suffix_cache, None))
+
+    # prefix sharing is host-side bookkeeping ONLY: a prefix-enabled batcher
+    # whose pool really holds shared (refcount > 1) pages must feed the
+    # byte-identical ragged step graph as the zero-table trace — sharing may
+    # change the table DATA, never the traced GRAPH
+    try:
+        pbat = batching.ContinuousBatcher(
+            cfg, params, batching.BatchingConfig(
+                page_size=PGS, num_pages=NPG, max_slots=MS,
+                pages_per_slot=PPS,
+                prefix_cache=paged_kv.PrefixCacheConfig(enabled=True)))
+        pshared = np.arange(1, 1 + PGS, dtype=np.int32)  # one full page
+        pbat.submit(np.concatenate([pshared, [99]]).astype(np.int32), 4,
+                    temperature=0.0, rng_seed=0)
+        pbat.submit(np.concatenate([pshared, [98]]).astype(np.int32), 4,
+                    temperature=0.0, rng_seed=1)
+        pbat.step()  # admit both: the shared page is live under two slots
+        if pbat.pool.shared_pages < 1:
+            raise AssertionError("driver bug: no page ended up shared")
+        live_tab, live_lens = pbat.pool.device_tables()
+        live_toks = jnp.zeros((MS,), jnp.int32)
+        ident = check_identity(
+            "batching.prefix-disabled-identity",
+            lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                cfg, p, pk, pv, pt, ln, t),
+            (params, pbat.pool.pool.k, pbat.pool.pool.v, live_tab,
+             live_lens, live_toks),
+            lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                cfg, p, pk, pv, pt, ln, t),
+            (params, ppool.k, ppool.v, ptab, plens, ptoks),
+            what="prefix-enabled batcher's ragged decode-step graph")
+        (findings.extend(ident) if ident
+         else checked.append("batching.prefix-disabled-identity"))
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("batching.prefix-disabled-identity", e))
+
+    # ---- prefix token identity: a mixed trace (two prompts sharing a
+    # ---- prefix + one disjoint, mixed temperatures) must emit token-for-
+    # ---- token what the prefix-DISABLED batcher emits — the EXECUTED half
+    # ---- of the contract (suffix prefill + COW are value properties no
+    # ---- jaxpr hash can witness) ----------------------------------------
+    try:
+        prng = np.random.default_rng(7)
+        pfx = prng.integers(1, 128, size=PGS).astype(np.int32)
+        pprompts = [
+            np.concatenate([pfx, prng.integers(1, 128, size=3)]),
+            np.concatenate([pfx, prng.integers(1, 128, size=2)]),
+            prng.integers(1, 128, size=6).astype(np.int32),
+        ]
+        ptemps = [0.0, 0.8, 0.0]
+
+        def _trace(prefix_cache):
+            b = batching.ContinuousBatcher(
+                cfg, params, batching.BatchingConfig(
+                    page_size=PGS, num_pages=NPG, max_slots=MS,
+                    pages_per_slot=PPS, prefix_cache=prefix_cache))
+            sids = [b.submit(pp.astype(np.int32), 3, temperature=t,
+                             rng_seed=i)
+                    for i, (pp, t) in enumerate(zip(pprompts, ptemps))]
+            out = b.run()
+            b.pool.check_invariants()
+            return [out[s].tolist() for s in sids], b.pool.prefix_counters
+
+        base_toks, _ = _trace(None)
+        got_toks, pc = _trace(paged_kv.PrefixCacheConfig(enabled=True))
+        if got_toks != base_toks:
+            findings.append(Finding(
+                layer="graph", rule="GC-identity",
+                where="batching.prefix-token-identity", line=0,
+                message=f"prefix-enabled batched decode diverged from the "
+                        f"non-shared path: {got_toks} != {base_toks}"))
+        elif pc["hits"] < 1 or pc["saved_tokens"] < 1:
+            findings.append(Finding(
+                layer="graph", rule="GC-identity",
+                where="batching.prefix-token-identity", line=0,
+                message=f"prefix trace never hit the index (hits="
+                        f"{pc['hits']}, saved={pc['saved_tokens']}): the "
+                        f"parity check proved nothing"))
+        else:
+            checked.append("batching.prefix-token-identity")
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("batching.prefix-token-identity", e))
+
     # ---- split pipeline: boundary hops over a real 2-stage mesh ---------
     if len(jax.devices()) < 2:
         skipped.append("split/fault contracts: needs >= 2 devices "

@@ -40,7 +40,8 @@ so the (L, num_pages, page_size) arrays update in place — the
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+from dataclasses import dataclass
+from typing import Iterator, NamedTuple, Optional
 
 import numpy as np
 import jax
@@ -53,6 +54,218 @@ from .transformer import (_cast_params, _layernorm, _rmsnorm, _rotate_half,
 
 #: slot id a page belongs to when it is on the free list
 FREE = -1
+
+#: owner sentinel for a page referenced by more than one holder (several
+#: slots, or a slot plus the prefix index) — no single slot may write it
+SHARED = -2
+
+
+@dataclass(frozen=True)
+class PrefixCacheConfig:
+    """Knobs for prefix sharing over the paged pool.
+
+    min_shared_block: minimum matched prefix length (tokens) before an admit
+        takes the shared path — below it the index is consulted but the
+        request prefills privately (tiny matches are not worth the COW fork
+        their first decode write costs).
+    max_index_pages: cap on the number of index NODES (each node pins one
+        page); 0 = uncapped. At the cap, registration evicts LRU leaves
+        first and gives up if every leaf is still live in some slot.
+    """
+
+    enabled: bool = True
+    min_shared_block: int = 1
+    max_index_pages: int = 0
+
+    def __post_init__(self):
+        if self.min_shared_block < 1:
+            raise ValueError(f"min_shared_block must be >= 1, got "
+                             f"{self.min_shared_block}")
+        if self.max_index_pages < 0:
+            raise ValueError(f"max_index_pages must be >= 0 (0 = uncapped), "
+                             f"got {self.max_index_pages}")
+
+
+class _PrefixNode:
+    """One page's worth of a registered prompt prefix.
+
+    A node maps one token-id block to the page holding its post-rotary K/V,
+    valid only under this node's PATH (positions are absolute from 0, so
+    the same block under a different parent chain is a different node).
+    ``full`` nodes cover exactly ``page_size`` tokens and may have children;
+    ``partial`` nodes cover the tail of a registered prompt (< page_size
+    tokens) and are always leaves — a partial page cannot be extended
+    in-place without invalidating sharers, which is exactly what
+    :meth:`PagedKVCache.fork_page` (COW) exists to avoid.
+    """
+
+    __slots__ = ("tokens", "page", "full", "parent", "children", "partials",
+                 "stamp")
+
+    def __init__(self, tokens: tuple, page: int, full: bool,
+                 parent: Optional["_PrefixNode"], stamp: int):
+        self.tokens = tokens
+        self.page = page
+        self.full = full
+        self.parent = parent
+        self.children: dict[tuple, _PrefixNode] = {}
+        self.partials: list[_PrefixNode] = []
+        self.stamp = stamp
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self.children and not self.partials
+
+
+class PrefixIndex:
+    """Radix index over page-granular token blocks.
+
+    Keyed by the token-id block itself (a python tuple — its hash IS the
+    token-block hash; collisions are impossible by construction, unlike a
+    rolling digest). Depth j in the trie is page j of a prompt: walking
+    full-block children from the root matches ever-longer page-aligned
+    prefixes, and each matched node names a pool page that already holds
+    that block's K/V. LRU stamps order eviction; reclaiming always drops
+    leaves first so interior nodes never strand unreachable holds.
+    """
+
+    def __init__(self, page_size: int):
+        self.page_size = page_size
+        self.root = _PrefixNode((), 0, True, None, 0)
+        self._clock = 0
+        self._count = 0
+
+    # -- bookkeeping -------------------------------------------------------
+
+    @property
+    def num_nodes(self) -> int:
+        return self._count
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def touch(self, node: _PrefixNode) -> None:
+        self._tick()
+        # refresh the whole path: evicting an ancestor of a hot leaf would
+        # orphan it, so LRU order must be path-monotone (parent >= child)
+        while node is not None and node is not self.root:
+            node.stamp = self._clock
+            node = node.parent
+
+    def iter_nodes(self) -> Iterator[_PrefixNode]:
+        """Every node except the root, preorder (parents first)."""
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            if node is not self.root:
+                yield node
+            stack.extend(node.partials)
+            stack.extend(node.children.values())
+
+    def leaves(self) -> list[_PrefixNode]:
+        return [n for n in self.iter_nodes() if n.is_leaf]
+
+    # -- match / insert / remove ------------------------------------------
+
+    def match(self, tokens) -> list[tuple[_PrefixNode, int]]:
+        """Longest page-aligned match of ``tokens`` against the index.
+
+        Returns [(node, claimed_tokens), ...] along the match path: full
+        interior blocks claim ``page_size`` tokens each; one final node may
+        claim fewer — the longest-common-prefix row count of a partial leaf
+        (or of a full block the request diverges inside). Claimed rows of
+        the final page are valid for THIS request; rows past the claim are
+        the donor's K/V, which per-slot length masking never reads."""
+        ps = self.page_size
+        out: list[tuple[_PrefixNode, int]] = []
+        node = self.root
+        j = 0
+        while (j + 1) * ps <= len(tokens):
+            key = tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                break
+            out.append((child, ps))
+            node = child
+            j += 1
+        rest = [int(t) for t in tokens[j * ps:]]
+        best, best_m = None, 0
+        for cand in list(node.partials) + list(node.children.values()):
+            m = 0
+            for a, b in zip(cand.tokens, rest):
+                if a != b:
+                    break
+                m += 1
+            if m > best_m:
+                best, best_m = cand, m
+        if best is not None and best_m > 0:
+            out.append((best, best_m))
+        return out
+
+    def insert_full(self, parent: _PrefixNode, key: tuple,
+                    page: int) -> _PrefixNode:
+        node = _PrefixNode(key, page, True, parent, self._tick())
+        parent.children[key] = node
+        self._count += 1
+        return node
+
+    def insert_partial(self, parent: _PrefixNode, tokens: tuple,
+                       page: int) -> _PrefixNode:
+        node = _PrefixNode(tokens, page, False, parent, self._tick())
+        parent.partials.append(node)
+        self._count += 1
+        return node
+
+    def remove(self, node: _PrefixNode) -> None:
+        """Detach a LEAF node (interior nodes must shed children first)."""
+        assert node.is_leaf, "only leaves are removable"
+        parent = node.parent
+        if node.full:
+            del parent.children[node.tokens]
+        else:
+            parent.partials.remove(node)
+        node.parent = None
+        self._count -= 1
+
+    # -- serialization (checkpoint round-trip) ----------------------------
+
+    def to_array(self) -> np.ndarray:
+        """Flatten to one int64 array: per node (preorder)
+        ``[depth, full, page, stamp, ntok, tok...]`` — the ndarray-friendly
+        form :class:`~edgellm_tpu.serve.recovery.DecodeCheckpoint` stores."""
+        rows: list[int] = []
+
+        def walk(node: _PrefixNode, depth: int) -> None:
+            for child in list(node.children.values()) + node.partials:
+                rows.extend([depth, int(child.full), child.page, child.stamp,
+                             len(child.tokens)])
+                rows.extend(int(t) for t in child.tokens)
+                walk(child, depth + 1)
+
+        walk(self.root, 0)
+        return np.asarray(rows, np.int64)
+
+    def load_array(self, flat: np.ndarray) -> None:
+        """Rebuild from :meth:`to_array` output (clears current contents)."""
+        self.root = _PrefixNode((), 0, True, None, 0)
+        self._count = 0
+        flat = np.asarray(flat, np.int64)
+        path = [self.root]  # path[d] = parent at depth d
+        i = 0
+        while i < flat.size:
+            depth, full, page, stamp, ntok = (int(x) for x in flat[i:i + 5])
+            tokens = tuple(int(t) for t in flat[i + 5:i + 5 + ntok])
+            i += 5 + ntok
+            parent = path[depth]
+            if full:
+                node = self.insert_full(parent, tokens, page)
+            else:
+                node = self.insert_partial(parent, tokens, page)
+            node.stamp = stamp
+            del path[depth + 1:]
+            path.append(node)
+        self._clock = max((n.stamp for n in self.iter_nodes()), default=0)
 
 
 class OutOfPages(RuntimeError):
@@ -132,6 +345,15 @@ def _permute_impl(pool_k, pool_v, src):
     return pool_k[:, src], pool_v[:, src]
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _copy_pages_impl(pool_k, pool_v, src, dst):
+    """COW fork: duplicate whole pages ``src`` (n,) into pages ``dst`` (n,).
+    The forking slot then writes its private copy; every other holder keeps
+    reading the original bytes."""
+    return (pool_k.at[:, dst].set(pool_k[:, src]),
+            pool_v.at[:, dst].set(pool_v[:, src]))
+
+
 class PagedKVCache:
     """Host-side allocator + device pool for up to ``max_slots`` concurrent
     streams of up to ``pages_per_slot * page_size`` tokens each.
@@ -146,7 +368,8 @@ class PagedKVCache:
 
     def __init__(self, cfg: ModelConfig, *, num_pages: int, page_size: int,
                  max_slots: int, pages_per_slot: int, dtype=jnp.float32,
-                 materialize: bool = True):
+                 materialize: bool = True,
+                 prefix_cache: Optional[PrefixCacheConfig] = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if pages_per_slot < 1:
@@ -169,7 +392,20 @@ class PagedKVCache:
         # LIFO free list, low pages first out — deterministic layouts
         self._free = list(range(num_pages - 1, 0, -1))
         self._slot_pages: list[list[int]] = [[] for _ in range(max_slots)]
-        self._owner = np.full((num_pages,), FREE, np.int32)  # page -> slot
+        # page -> exclusive slot, or SHARED (>1 holder / index-held), or FREE
+        self._owner = np.full((num_pages,), FREE, np.int32)
+        # per-page reference counts: one per slot-table entry + one per
+        # prefix-index node; a page returns to the free list ONLY at 0
+        self._refcount = np.zeros((num_pages,), np.int32)
+        self._index_holds = np.zeros((num_pages,), np.int32)
+        self.prefix_cfg = prefix_cache
+        self.prefix: Optional[PrefixIndex] = (
+            PrefixIndex(page_size)
+            if prefix_cache is not None and prefix_cache.enabled else None)
+        # host counters; read lock-free by report scrapes (GIL-atomic ints)
+        self.prefix_counters = {"hits": 0, "misses": 0, "saved_tokens": 0,
+                                "cow_forks": 0, "index_evictions": 0,
+                                "reclaimed_pages": 0}
 
     # -- geometry ----------------------------------------------------------
 
@@ -191,6 +427,41 @@ class PagedKVCache:
     def live_tokens(self) -> int:
         return int(self.lengths[self.active].sum())
 
+    @property
+    def unique_live_tokens(self) -> int:
+        """Live tokens counting each physical page ONCE: per page, the max
+        coverage over every slot referencing it. Equals :attr:`live_tokens`
+        when nothing is shared; under prefix sharing it is the honest
+        occupancy numerator (summing per-slot lengths over-counts aliased
+        pages — the ``report()`` occupancy bug this property fixes)."""
+        cover = np.zeros((self.num_pages,), np.int64)
+        for s in range(self.max_slots):
+            if not self.active[s]:
+                continue
+            n = int(self.lengths[s])
+            for j, p in enumerate(self._slot_pages[s]):
+                c = min(self.page_size, n - j * self.page_size)
+                if c > 0:
+                    cover[p] = max(cover[p], c)
+        return int(cover.sum())
+
+    @property
+    def shared_pages(self) -> int:
+        """Pages with more than one holder (slots and/or the index)."""
+        return int(np.sum(self._refcount > 1))
+
+    @property
+    def index_pages(self) -> int:
+        """Pages pinned by at least one prefix-index node."""
+        return int(np.sum(self._index_holds > 0))
+
+    @property
+    def reclaimable_index_pages(self) -> int:
+        """Pages held ONLY by the index — :meth:`ensure` frees these
+        LRU-first under pressure, so admission feasibility may count them
+        as available."""
+        return int(np.sum((self._refcount == 1) & (self._index_holds == 1)))
+
     def pages_for(self, n_tokens: int) -> int:
         return -(-n_tokens // self.page_size)
 
@@ -207,8 +478,10 @@ class PagedKVCache:
 
     def ensure(self, slot: int, new_length: int) -> None:
         """Grow ``slot``'s page list to cover ``new_length`` positions,
-        allocating pages from the free list. Raises :class:`OutOfPages`
-        (allocating nothing) when the pool cannot cover the growth."""
+        allocating pages from the free list. Under pool pressure, pages held
+        ONLY by the prefix index (refcount would drop to 0) are reclaimed
+        LRU-first before giving up. Raises :class:`OutOfPages` (allocating
+        nothing) when the pool still cannot cover the growth."""
         if not self.active[slot]:
             raise ValueError(f"slot {slot} is not active")
         if new_length > self.span:
@@ -218,27 +491,305 @@ class PagedKVCache:
         if need <= 0:
             return
         if need > len(self._free):
+            self._reclaim_index_pages(need - len(self._free))
+        if need > len(self._free):
             raise OutOfPages(
                 f"slot {slot} needs {need} page(s), {len(self._free)} free")
         for _ in range(need):
             p = self._free.pop()
             self._owner[p] = slot
+            self._refcount[p] = 1
             self.page_table[slot, len(self._slot_pages[slot])] = p
             self._slot_pages[slot].append(p)
 
     def free_slot(self, slot: int) -> None:
-        """Release a slot and return its pages (reverse allocation order, so
-        the free list stays LIFO-deterministic). The page contents are left
-        stale — masked attention never reads past a slot's length."""
+        """Release a slot; each of its pages drops one reference and returns
+        to the free list only at refcount 0 (reverse allocation order, so the
+        free list stays LIFO-deterministic). Shared pages survive for their
+        other holders. The page contents are left stale — masked attention
+        never reads past a slot's length."""
         if not self.active[slot]:
             raise ValueError(f"slot {slot} is not active")
         for p in reversed(self._slot_pages[slot]):
-            self._owner[p] = FREE
-            self._free.append(p)
+            self._release_ref(p)
         self._slot_pages[slot] = []
         self.page_table[slot] = 0
         self.lengths[slot] = 0
         self.active[slot] = False
+
+    # -- reference counting / prefix sharing -------------------------------
+
+    def _release_ref(self, p: int) -> None:
+        """Drop one reference to page ``p``; free it at refcount 0."""
+        assert self._refcount[p] > 0, f"refcount underflow on page {p}"
+        self._refcount[p] -= 1
+        if self._refcount[p] == 0:
+            self._owner[p] = FREE
+            self._free.append(p)
+        else:
+            self._recompute_owner(p)
+
+    def _recompute_owner(self, p: int) -> None:
+        """Keep the owner sentinel precise after a reference change: the
+        single referencing slot when exclusive, SHARED otherwise."""
+        if self._refcount[p] == 0:
+            self._owner[p] = FREE
+            return
+        holders = [s for s in range(self.max_slots)
+                   if p in self._slot_pages[s]]
+        if len(holders) == 1 and self._index_holds[p] == 0:
+            self._owner[p] = holders[0]
+        else:
+            self._owner[p] = SHARED
+
+    def _drop_index_hold(self, p: int) -> None:
+        assert self._index_holds[p] > 0
+        self._index_holds[p] -= 1
+        self._release_ref(p)
+
+    def _add_index_hold(self, p: int) -> None:
+        self._index_holds[p] += 1
+        self._refcount[p] += 1
+        self._owner[p] = SHARED
+
+    def _evict_index_leaf(self, node) -> None:
+        self.prefix.remove(node)
+        self.prefix_counters["index_evictions"] += 1
+        self._drop_index_hold(node.page)
+
+    def _reclaim_index_pages(self, want: int) -> int:
+        """Free up to ``want`` pages by evicting LRU index leaves whose page
+        is held ONLY by the index (refcount 1 → dropping the hold frees it).
+        Repeats so a freed leaf exposes its now-leaf parent. Returns the
+        number of pages actually freed."""
+        if self.prefix is None:
+            return 0
+        freed = 0
+        while freed < want:
+            candidates = [n for n in self.prefix.leaves()
+                          if self._refcount[n.page] == 1]
+            if not candidates:
+                break
+            victim = min(candidates, key=lambda n: (n.stamp, n.page))
+            self._evict_index_leaf(victim)
+            freed += 1
+        self.prefix_counters["reclaimed_pages"] += freed
+        return freed
+
+    def probe_prefix(self, tokens, max_tokens: Optional[int] = None) -> dict:
+        """Dry-run :meth:`share_prefix`: what WOULD an admit reuse?
+        Returns {"tokens": claimable prefix length, "pages": index pages a
+        match would map, "forks": COW forks the suffix write would trigger
+        (1 when the match ends mid-page)} — the admit feasibility check uses
+        this to count pages the slot will NOT need from the free list."""
+        if self.prefix is None:
+            return {"tokens": 0, "pages": 0, "forks": 0}
+        limit = (len(tokens) if max_tokens is None
+                 else min(int(max_tokens), len(tokens)))
+        claimed, pages = 0, 0
+        for node, claim in self.prefix.match(tokens):
+            take = min(claim, limit - claimed)
+            if take <= 0:
+                break
+            claimed += take
+            pages += 1
+        if claimed < (self.prefix_cfg.min_shared_block
+                      if self.prefix_cfg else 1):
+            return {"tokens": 0, "pages": 0, "forks": 0}
+        return {"tokens": claimed, "pages": pages,
+                "forks": 1 if claimed % self.page_size else 0}
+
+    def share_prefix(self, slot: int, tokens,
+                     max_tokens: Optional[int] = None) -> int:
+        """Map the longest indexed prefix of ``tokens`` into a FRESH slot's
+        page table with zero data movement: each matched index page gains one
+        reference and lands in the slot's next table row; the slot's length
+        becomes the claimed token count. ``max_tokens`` caps the claim (the
+        batcher passes S-1 so at least one suffix token remains to produce
+        the first sampled logits). Returns the claimed length (0 = miss or
+        below ``min_shared_block`` — the slot is untouched)."""
+        if self.prefix is None:
+            return 0
+        if not self.active[slot] or self._slot_pages[slot]:
+            raise ValueError(
+                f"share_prefix needs a fresh active slot; slot {slot} "
+                f"already owns {len(self._slot_pages[slot])} page(s)")
+        limit = (len(tokens) if max_tokens is None
+                 else min(int(max_tokens), len(tokens)))
+        matched = self.prefix.match(tokens)
+        claimed = 0
+        mapped: list = []
+        for node, claim in matched:
+            take = min(claim, limit - claimed)
+            if take <= 0:
+                break
+            claimed += take
+            mapped.append(node)
+        if claimed < (self.prefix_cfg.min_shared_block
+                      if self.prefix_cfg else 1):
+            self.prefix_counters["misses"] += 1
+            return 0
+        for node in mapped:
+            p = node.page
+            self._refcount[p] += 1
+            self._owner[p] = SHARED
+            self.page_table[slot, len(self._slot_pages[slot])] = p
+            self._slot_pages[slot].append(p)
+            self.prefix.touch(node)
+        self.lengths[slot] = claimed
+        self.prefix_counters["hits"] += 1
+        self.prefix_counters["saved_tokens"] += claimed
+        return claimed
+
+    def _index_make_room(self, protect: set) -> bool:
+        """Honor ``max_index_pages``: evict LRU leaves (never ``protect``,
+        the registration path in flight) until a node fits. False = every
+        evictable leaf is protected, caller should stop registering."""
+        cap = self.prefix_cfg.max_index_pages if self.prefix_cfg else 0
+        if cap <= 0:
+            return True
+        while self.prefix.num_nodes >= cap:
+            candidates = [n for n in self.prefix.leaves()
+                          if n not in protect]
+            if not candidates:
+                return False
+            self._evict_index_leaf(
+                min(candidates, key=lambda n: (n.stamp, n.page)))
+        return True
+
+    def register_prefix(self, slot: int, tokens) -> int:
+        """Publish ``slot``'s prompt pages into the index so later admits can
+        share them: one full-block node per fully-covered page, plus one
+        partial leaf for the tail. Blocks already indexed (a donor's, or the
+        shared pages this very slot mapped) are LRU-touched, not re-pinned.
+        Newly indexed pages gain an index reference — the slot's own first
+        decode write into its partial page will COW-fork, leaving the
+        registered bytes immutable. Returns the number of nodes added."""
+        if self.prefix is None:
+            return 0
+        ps = self.page_size
+        pages = self._slot_pages[slot]
+        node = self.prefix.root
+        added = 0
+        walked: set = set()
+        j = 0
+        while (j + 1) * ps <= len(tokens) and j < len(pages):
+            key = tuple(int(t) for t in tokens[j * ps:(j + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                if not self._index_make_room(walked):
+                    return added
+                child = self.prefix.insert_full(node, key, pages[j])
+                self._add_index_hold(pages[j])
+                added += 1
+            else:
+                self.prefix.touch(child)
+            walked.add(child)
+            node = child
+            j += 1
+        tail = tuple(int(t) for t in tokens[j * ps:])
+        if tail and j < len(pages):
+            for cand in node.partials:
+                if cand.tokens == tail:
+                    self.prefix.touch(cand)
+                    return added
+            if not self._index_make_room(walked):
+                return added
+            self.prefix.insert_partial(node, tail, pages[j])
+            self._add_index_hold(pages[j])
+            added += 1
+        return added
+
+    def release_prefix(self, tokens=None) -> int:
+        """Drop index pins: the whole index (``tokens=None``) or the deepest
+        exclusive suffix of one registered path. Pages whose refcount hits 0
+        return to the free list. Returns the number of nodes released."""
+        if self.prefix is None:
+            return 0
+        if tokens is None:
+            dropped = 0
+            while True:
+                leaves = self.prefix.leaves()
+                if not leaves:
+                    break
+                for leaf in leaves:
+                    self._evict_index_leaf(leaf)
+                    dropped += 1
+            return dropped
+        chain = [node for node, _ in self.prefix.match(tokens)]
+        dropped = 0
+        for node in reversed(chain):
+            if not node.is_leaf:
+                break
+            self._evict_index_leaf(node)
+            dropped += 1
+        return dropped
+
+    # -- copy-on-write -----------------------------------------------------
+
+    def fork_page(self, slot: int, page_index: int) -> tuple[int, int]:
+        """COW: give ``slot`` a private copy-slot for its ``page_index``-th
+        page. Allocates a fresh page, repoints the slot's table row, and
+        drops one reference on the shared original (every other holder keeps
+        it). Returns (old_page, new_page) — the DEVICE copy is the caller's
+        job (:meth:`ensure_writable` does it for a materialized pool; the
+        split batcher routes the pair through the runtime's per-stage
+        pools)."""
+        old = self._slot_pages[slot][page_index]
+        assert self._refcount[old] > 1, \
+            f"fork_page on exclusively-owned page {old}"
+        if not self._free:
+            self._reclaim_index_pages(1)
+        if not self._free:
+            raise OutOfPages(
+                f"COW fork for slot {slot} needs a free page, 0 free")
+        new = self._free.pop()
+        self._refcount[new] = 1
+        self._owner[new] = slot
+        self._refcount[old] -= 1
+        self._recompute_owner(old)
+        self._slot_pages[slot][page_index] = new
+        self.page_table[slot, page_index] = new
+        self.prefix_counters["cow_forks"] += 1
+        return old, new
+
+    def prepare_write(self, slot: int, new_length: int,
+                      start: Optional[int] = None) -> list:
+        """Fork every SHARED page the write range
+        ``[lengths[slot], new_length)`` touches (bookkeeping only; ``start``
+        overrides the range's left edge — :meth:`adopt` rewrites from 0).
+        Returns the (old, new) copy list the device pools must apply before
+        any row in the range is written."""
+        left = int(self.lengths[slot]) if start is None else int(start)
+        start = left // self.page_size
+        stop = min(self.pages_for(new_length), len(self._slot_pages[slot]))
+        forks = [j for j in range(start, stop)
+                 if self._refcount[self._slot_pages[slot][j]] > 1]
+        # all-or-nothing: a fork that fails MID-loop would leave earlier
+        # forks' table rows pointing at pages whose device copy never ran
+        if len(forks) > len(self._free):
+            self._reclaim_index_pages(len(forks) - len(self._free))
+        if len(forks) > len(self._free):
+            raise OutOfPages(
+                f"slot {slot} needs {len(forks)} COW fork(s), "
+                f"{len(self._free)} page(s) free")
+        return [self.fork_page(slot, j) for j in forks]
+
+    def ensure_writable(self, slot: int, new_length: int) -> list:
+        """:meth:`ensure` + COW: after this, every page covering
+        ``[lengths[slot], new_length)`` is exclusively owned by ``slot`` and
+        safe to write in place. On a materialized pool the page copies run
+        here; bookkeeping-only callers (the split batcher) get the (old, new)
+        pairs back and must apply them to their own per-stage pools."""
+        self.ensure(slot, new_length)
+        pairs = self.prepare_write(slot, new_length)
+        if pairs and self.pool is not None:
+            k, v = _copy_pages_impl(
+                self.pool.k, self.pool.v,
+                jnp.asarray([o for o, _ in pairs], jnp.int32),
+                jnp.asarray([n for _, n in pairs], jnp.int32))
+            self.pool = PagePool(k, v)
+        return pairs
 
     def device_tables(self) -> tuple[jnp.ndarray, jnp.ndarray]:
         """(page_table (max_slots, pages_per_slot), lengths (max_slots,)) as
@@ -262,13 +813,33 @@ class PagedKVCache:
     def adopt(self, slot: int, k_seq, v_seq, length: int) -> None:
         """Write a contiguous (L, length, KV, hd) post-rotary K/V prefix
         (a prefill's cache, or a restored checkpoint) into ``slot``'s pages
-        and set its length. Allocates pages as needed."""
+        and set its length. Allocates pages as needed; any shared page in
+        the range is COW-forked first (no device copy — every row the fork
+        exposes is overwritten here, and rows past ``length`` stay masked)."""
         self._require_pool("adopt")
         self.ensure(slot, length)
+        self.prepare_write(slot, length, start=0)
         dest = jnp.asarray(self._flat_indices(slot, length))
         k, v = _adopt_impl(self.pool.k, self.pool.v, k_seq, v_seq, dest)
         self.pool = PagePool(k, v)
         self.lengths[slot] = length
+
+    def adopt_rows(self, slot: int, k_seq, v_seq,
+                   start: int, stop: int) -> None:
+        """Suffix variant of :meth:`adopt`: write (L, stop-start, KV, hd)
+        post-rotary K/V into rows ``[start, stop)`` of ``slot`` — the
+        prefix-sharing admit path lands ONLY the unmatched suffix here, the
+        shared rows below ``start`` stay aliased. ``start`` must equal the
+        slot's current length (the shared-prefix claim)."""
+        self._require_pool("adopt_rows")
+        if start != int(self.lengths[slot]):
+            raise ValueError(f"adopt_rows start {start} != slot {slot} "
+                             f"length {int(self.lengths[slot])}")
+        self.ensure_writable(slot, stop)
+        dest = jnp.asarray(self._flat_indices(slot, stop)[start:])
+        k, v = _adopt_impl(self.pool.k, self.pool.v, k_seq, v_seq, dest)
+        self.pool = PagePool(k, v)
+        self.lengths[slot] = stop
 
     def gather_slot(self, slot: int) -> dict:
         """Read ``slot``'s K/V back as the contiguous host state dict the
@@ -293,25 +864,42 @@ class PagedKVCache:
         # page with a HIGHER id (e.g. slot pages [[4],[2],[1]] with page 3
         # free), so inverting an old->new map would collide with the free
         # page's identity entry and gather garbage into the destination.
-        # Place owned pages at their destinations first, then spread the
-        # leftover old pages over the remaining destinations.
+        # Place referenced pages at their destinations first, then spread the
+        # leftover old pages over the remaining destinations. A SHARED page
+        # gets its destination on FIRST encounter and every later holder —
+        # other slots' table rows, index nodes — repoints to that same id,
+        # so it moves exactly once.
         src = np.zeros((self.num_pages,), np.int32)  # new -> old; src[0] = 0
+        new_of: dict = {}
         moved = 0
         nxt = 1
+
+        def place(p: int) -> int:
+            nonlocal moved, nxt
+            if p in new_of:
+                return new_of[p]
+            src[nxt] = p
+            if p != nxt:
+                moved += 1
+            new_of[p] = nxt
+            nxt += 1
+            return new_of[p]
+
         for s in range(self.max_slots):
             pages = self._slot_pages[s]
             for j, p in enumerate(pages):
-                src[nxt] = p
-                if p != nxt:
-                    moved += 1
-                pages[j] = nxt
-                self.page_table[s, j] = nxt
-                self._owner[nxt] = s
-                nxt += 1
+                pages[j] = place(p)
+                self.page_table[s, j] = pages[j]
+        if self.prefix is not None:
+            for node in self.prefix.iter_nodes():
+                node.page = place(node.page)
         placed = set(int(x) for x in src[:nxt])
         src[nxt:] = [p for p in range(1, self.num_pages) if p not in placed]
-        for p in range(nxt, self.num_pages):
-            self._owner[p] = FREE
+        # bookkeeping arrays ride the same permutation (free pages carry
+        # FREE/0/0, so the gather is correct for the whole range).
+        self._owner = self._owner[src].copy()
+        self._refcount = self._refcount[src].copy()
+        self._index_holds = self._index_holds[src].copy()
         self._free = list(range(self.num_pages - 1, nxt - 1, -1))
         if moved:
             k, v = _permute_impl(self.pool.k, self.pool.v, jnp.asarray(src))
@@ -325,14 +913,23 @@ class PagedKVCache:
         (Per-slot checkpoints use :meth:`gather_slot` instead, which is
         geometry-independent.)"""
         self._require_pool("state_dict")
-        return {"k": np.asarray(self.pool.k), "v": np.asarray(self.pool.v),
-                "page_table": self.page_table.copy(),
-                "lengths": self.lengths.copy(),
-                "active": self.active.copy(),
-                "free": np.asarray(self._free, np.int32)}
+        state = {"k": np.asarray(self.pool.k), "v": np.asarray(self.pool.v),
+                 "page_table": self.page_table.copy(),
+                 "lengths": self.lengths.copy(),
+                 "active": self.active.copy(),
+                 "free": np.asarray(self._free, np.int32),
+                 "refcount": self._refcount.copy(),
+                 "index_holds": self._index_holds.copy()}
+        if self.prefix is not None:
+            state["prefix_index"] = self.prefix.to_array()
+        return state
 
     def load_state_dict(self, state: dict) -> None:
-        """Restore :meth:`state_dict` output bit-exactly (same geometry)."""
+        """Restore :meth:`state_dict` output bit-exactly (same geometry).
+        Refcounts, index holds, and the serialized radix index round-trip
+        when present; pre-sharing checkpoints (no ``refcount`` key) derive
+        exclusive refcounts from the slot tables, so restore never
+        double-frees or leaks a page either way."""
         self._require_pool("load_state_dict")
         if state["k"].shape != self.pool.k.shape:
             raise ValueError(
@@ -344,40 +941,116 @@ class PagedKVCache:
         self.lengths = np.asarray(state["lengths"], np.int32).copy()
         self.active = np.asarray(state["active"], bool).copy()
         self._free = [int(p) for p in state["free"]]
-        self._owner = np.full((self.num_pages,), FREE, np.int32)
         self._slot_pages = [[] for _ in range(self.max_slots)]
         for s in range(self.max_slots):
             if not self.active[s]:
                 continue
             n = self.pages_for(int(self.lengths[s]))
             self._slot_pages[s] = [int(p) for p in self.page_table[s, :n]]
-            for p in self._slot_pages[s]:
-                self._owner[p] = s
+        if "refcount" in state:
+            self._refcount = np.asarray(state["refcount"], np.int32).copy()
+            self._index_holds = np.asarray(state["index_holds"],
+                                           np.int32).copy()
+        else:
+            self._refcount = np.zeros((self.num_pages,), np.int32)
+            self._index_holds = np.zeros((self.num_pages,), np.int32)
+            for pages in self._slot_pages:
+                for p in pages:
+                    self._refcount[p] += 1
+        if self.prefix is not None:
+            self.prefix = PrefixIndex(self.page_size)
+            if state.get("prefix_index") is not None:
+                self.prefix.load_array(np.asarray(state["prefix_index"]))
+        elif self._index_holds.any():
+            # sharing-era checkpoint restored into a prefix-disabled cache:
+            # the index is gone, so its holds must not pin (or leak) pages.
+            for p in np.nonzero(self._index_holds)[0]:
+                self._refcount[p] -= self._index_holds[p]
+                self._index_holds[p] = 0
+                if self._refcount[p] == 0:
+                    self._free.append(int(p))
+        self._owner = np.full((self.num_pages,), FREE, np.int32)
+        for p in range(1, self.num_pages):
+            if self._refcount[p] > 0:
+                self._recompute_owner(p)
 
     # -- invariants --------------------------------------------------------
 
     def check_invariants(self) -> None:
-        """Raise AssertionError on any aliasing/leak/ownership violation —
-        the test suite calls this after every mutation."""
+        """Raise AssertionError on any aliasing/leak/ownership/refcount
+        violation — the test suite calls this after every mutation."""
         assert 0 not in self._free, "trash page 0 on the free list"
         assert self._owner[0] == FREE, "trash page 0 owned by a slot"
-        owned = [p for pages in self._slot_pages for p in pages]
-        assert len(owned) == len(set(owned)), \
-            f"page owned twice: {sorted(owned)}"
-        assert not (set(owned) & set(self._free)), "page both owned and free"
-        assert set(owned) | set(self._free) == set(range(1, self.num_pages)), \
-            "page leaked (neither owned nor free)"
+        assert self._refcount[0] == 0, "trash page 0 referenced"
+        # ground truth: refcount == slot-table references + index holds.
+        expect = np.zeros((self.num_pages,), np.int32)
+        holders: list = [[] for _ in range(self.num_pages)]
+        for s, pages in enumerate(self._slot_pages):
+            assert len(pages) == len(set(pages)), \
+                f"slot {s} references a page twice: {pages}"
+            for p in pages:
+                expect[p] += 1
+                holders[p].append(s)
+        index_holds = np.zeros((self.num_pages,), np.int32)
+        if self.prefix is not None:
+            for node in self.prefix.iter_nodes():
+                index_holds[node.page] += 1
+                if node.full:
+                    assert len(node.tokens) == self.page_size, \
+                        f"full index node with {len(node.tokens)} tokens"
+                else:
+                    assert 0 < len(node.tokens) < self.page_size, \
+                        f"partial index node with {len(node.tokens)} tokens"
+                    assert not node.children and not node.partials, \
+                        "partial index node has children"
+        expect += index_holds
+        assert (self._index_holds == index_holds).all(), \
+            f"index holds drifted: {self._index_holds} vs {index_holds}"
+        assert (self._refcount == expect).all(), \
+            f"refcounts drifted: {self._refcount} vs {expect}"
+        referenced = set(int(p) for p in np.nonzero(expect)[0])
+        assert not (referenced & set(self._free)), \
+            "page both referenced and free"
+        assert referenced | set(self._free) == \
+            set(range(1, self.num_pages)), \
+            "page leaked (neither referenced nor free)"
+        for p in range(1, self.num_pages):
+            if expect[p] == 0:
+                assert self._owner[p] == FREE, f"free page {p} has an owner"
+            elif expect[p] == 1 and len(holders[p]) == 1:
+                assert self._owner[p] == holders[p][0], \
+                    f"exclusive page {p} owner {self._owner[p]} != " \
+                    f"slot {holders[p][0]}"
+            else:
+                assert self._owner[p] == SHARED, \
+                    f"shared page {p} owner {self._owner[p]} != SHARED"
         for s in range(self.max_slots):
             if self.active[s]:
                 assert len(self._slot_pages[s]) * self.page_size >= \
                     self.lengths[s], f"slot {s} pages do not cover its length"
                 for j, p in enumerate(self._slot_pages[s]):
-                    assert self._owner[p] == s
                     assert self.page_table[s, j] == p
             else:
                 assert not self._slot_pages[s], f"inactive slot {s} owns pages"
                 assert (self.page_table[s] == 0).all()
                 assert self.lengths[s] == 0
+
+    def prefix_report(self) -> dict:
+        """Host-side sharing stats for ``ContinuousBatcher.report()`` and
+        the obs gauges. Cheap — no device sync."""
+        c = self.prefix_counters
+        total = c["hits"] + c["misses"]
+        return {"enabled": self.prefix is not None,
+                "hits": c["hits"], "misses": c["misses"],
+                "hit_rate": (c["hits"] / total) if total else 0.0,
+                "saved_tokens": c["saved_tokens"],
+                "cow_forks": c["cow_forks"],
+                "index_evictions": c["index_evictions"],
+                "reclaimed_pages": c["reclaimed_pages"],
+                "shared_pages": int(self.shared_pages),
+                "index_pages": int(self.index_pages),
+                "index_nodes": (self.prefix.num_nodes
+                                if self.prefix is not None else 0)}
 
 
 # ---------------------------------------------------------------------------

@@ -49,8 +49,8 @@ __all__ = [
     "Counter", "CounterSource", "Gauge", "Histogram", "MetricsRegistry",
     "format_table", "get_registry", "record_decode_stats",
     "record_link_counters", "record_link_health", "record_pipeline_stats",
-    "record_probe_decisions", "record_recovery_counters", "record_spec_stats",
-    "record_wire_bytes",
+    "record_prefix_stats", "record_probe_decisions",
+    "record_recovery_counters", "record_spec_stats", "record_wire_bytes",
 ]
 
 LabelKey = Tuple[Tuple[str, str], ...]
@@ -445,6 +445,46 @@ def record_decode_stats(stats: Optional[Mapping[str, Any]],
     if decode_s is not None:
         reg.gauge("edgellm_decode_decode_s",
                   "last call's decode-loop wall clock").set(float(decode_s))
+
+
+def record_prefix_stats(report: Optional[Mapping[str, Any]],
+                        registry: Optional[MetricsRegistry] = None) -> None:
+    """Absorb a :meth:`~edgellm_tpu.models.paged_kv.PagedKVCache.
+    prefix_report` dict as ``edgellm_prefix_*`` series: hit/miss/saved-token/
+    COW-fork counters (incremented with the report's running totals — call
+    once per drain, not per step) plus hit-rate and shared/index page-count
+    gauges — the numbers that say whether the radix index is earning its
+    pinned pages."""
+    reg = registry if registry is not None else _REGISTRY
+    if not reg.enabled or not report or not report.get("enabled"):
+        return
+    hits = report.get("hits")
+    if hits:
+        reg.counter("edgellm_prefix_hits_total",
+                    "admits that mapped shared prefix pages").inc(int(hits))
+    misses = report.get("misses")
+    if misses:
+        reg.counter("edgellm_prefix_misses_total",
+                    "admits with no usable indexed prefix").inc(int(misses))
+    saved = report.get("saved_tokens")
+    if saved:
+        reg.counter("edgellm_prefix_saved_tokens_total",
+                    "prefill token positions skipped via shared pages"
+                    ).inc(int(saved))
+    forks = report.get("cow_forks")
+    if forks:
+        reg.counter("edgellm_prefix_cow_forks_total",
+                    "copy-on-write page forks").inc(int(forks))
+    rate = report.get("hit_rate")
+    if rate is not None:
+        reg.gauge("edgellm_prefix_hit_rate",
+                  "prefix-index hits / lookups").set(float(rate))
+    reg.gauge("edgellm_prefix_shared_pages",
+              "pages currently referenced more than once").set(
+        float(report.get("shared_pages", 0)))
+    reg.gauge("edgellm_prefix_index_pages",
+              "pages currently pinned by the radix index").set(
+        float(report.get("index_pages", 0)))
 
 
 def record_wire_bytes(per_hop_bytes: Optional[Iterable[float]],

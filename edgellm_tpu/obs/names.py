@@ -54,6 +54,14 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     # speculative decode
     "edgellm_spec_acceptance_rate",
     "edgellm_spec_hops_per_token",
+    # prefix-sharing paged KV cache
+    "edgellm_prefix_hits_total",
+    "edgellm_prefix_misses_total",
+    "edgellm_prefix_saved_tokens_total",
+    "edgellm_prefix_cow_forks_total",
+    "edgellm_prefix_hit_rate",
+    "edgellm_prefix_shared_pages",
+    "edgellm_prefix_index_pages",
     # fused-hop probe provenance
     "edgellm_fused_hop_active",
     "edgellm_fused_hop_decision",

@@ -60,6 +60,16 @@ def _adopt_paged_impl(pool_k, pool_v, k_seq, v_seq, dest):
     return (flat_k.reshape(pool_k.shape), flat_v.reshape(pool_v.shape))
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _copy_paged_impl(pool_k, pool_v, src, dst):
+    """Duplicate whole pages inside the per-stage pools: pages ``src``
+    (1-D int32) are copied to pages ``dst`` — the device half of a prefix
+    COW fork (the host allocator already repointed the forking slot's table
+    rows). Donated, elementwise along "stage"."""
+    return (pool_k.at[:, :, dst].set(pool_k[:, :, src]),
+            pool_v.at[:, :, dst].set(pool_v[:, :, src]))
+
+
 @jax.jit
 def _gather_paged_impl(pool_k, pool_v, idx):
     """Inverse of :func:`_adopt_paged_impl` for one stream: gather the
@@ -1508,6 +1518,16 @@ class SplitRuntime:
         dest = jnp.asarray(dest, jnp.int32)
         pk, pv = _adopt_paged_impl(pool["k"], pool["v"], jnp.asarray(k_seq),
                                    jnp.asarray(v_seq), dest)
+        return {"k": pk, "v": pv}
+
+    def copy_paged_pages(self, pool: dict, src, dst) -> dict:
+        """Apply prefix-cache COW forks to the per-stage pools: duplicate
+        pages ``src`` to ``dst`` (parallel 1-D index lists from
+        ``PagedKVCache.ensure_writable``'s (old, new) pairs). Donates the
+        pool buffers; stage-elementwise, no collectives."""
+        pk, pv = _copy_paged_impl(pool["k"], pool["v"],
+                                  jnp.asarray(src, jnp.int32),
+                                  jnp.asarray(dst, jnp.int32))
         return {"k": pk, "v": pv}
 
     def gather_paged(self, pool: dict, idx: np.ndarray) -> tuple:

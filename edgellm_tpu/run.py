@@ -116,6 +116,7 @@ _PARAM_KEYS = {
     "pipeline": "split/serve",
     "serving": "serve",
     "batching": "serve",
+    "prefix_cache": "serve",
     "speculative": "serve",
     "max_compiles": "distances",
     "observability": "all",
@@ -367,9 +368,10 @@ def _validate_params_json(p: dict) -> None:
             die(f"batching must be an object of BatchingConfig fields, "
                 f"got {b!r}")
         # dtype fields are runtime objects, not JSON — keep them out of the
-        # schema so a typo'd key dies with the real field list
+        # schema so a typo'd key dies with the real field list; prefix_cache
+        # has its own top-level params block
         fields = {f.name for f in dataclasses.fields(BatchingConfig)} \
-            - {"compute_dtype", "cache_dtype"}
+            - {"compute_dtype", "cache_dtype", "prefix_cache"}
         bad = sorted(set(b) - fields)
         if bad:
             die(f"batching: unknown field(s) {bad}; known: {sorted(fields)}")
@@ -382,6 +384,35 @@ def _validate_params_json(p: dict) -> None:
         if need > bcfg.span:
             die(f"batching: soak requests need {need} cache positions > slot "
                 f"span {bcfg.span} (pages_per_slot x page_size)")
+    if "prefix_cache" in p:
+        from .models.paged_kv import PrefixCacheConfig
+
+        if exp != "serve":
+            die("prefix_cache only applies to experiment 'serve'")
+        if "batching" not in p:
+            die("prefix_cache rides the continuous batcher's paged pool — "
+                "add a 'batching' block")
+        pc = p["prefix_cache"]
+        if not isinstance(pc, dict):
+            die(f"prefix_cache must be an object of PrefixCacheConfig "
+                f"fields, got {pc!r}")
+        fields = {f.name for f in dataclasses.fields(PrefixCacheConfig)}
+        bad = sorted(set(pc) - fields)
+        if bad:
+            die(f"prefix_cache: unknown field(s) {bad}; "
+                f"known: {sorted(fields)}")
+        if "enabled" in pc and not isinstance(pc["enabled"], bool):
+            die(f"prefix_cache.enabled must be a boolean, "
+                f"got {pc['enabled']!r}")
+        for k in ("min_shared_block", "max_index_pages"):
+            if k in pc and (not isinstance(pc[k], int)
+                            or isinstance(pc[k], bool) or pc[k] < 0):
+                die(f"prefix_cache.{k} must be a non-negative integer, "
+                    f"got {pc[k]!r}")
+        try:
+            PrefixCacheConfig(**pc)
+        except (TypeError, ValueError) as e:
+            die(f"prefix_cache: {e}")
     if "pipeline" in p:
         from .parallel.split import PipelineConfig
 
@@ -509,6 +540,16 @@ def _print_serve_report(report: dict) -> None:
     rb = report["retry_budget"]
     print(f"  retry budget spent={rb['spent']} denied={rb['denied']} "
           f"available={rb['available']:.1f}")
+    pf = report.get("prefix")
+    if pf:
+        print(f"  prefix  hits={pf['hits']} misses={pf['misses']} "
+              f"hit_rate={pf['hit_rate']:.3f} "
+              f"prefill_tokens_saved={pf['saved_tokens']}")
+        print(f"  prefix  cow_forks={pf['cow_forks']} "
+              f"shared_pages={pf['shared_pages']} "
+              f"index_pages={pf['index_pages']} "
+              f"evictions={pf['index_evictions']} "
+              f"reclaimed={pf['reclaimed_pages']}")
 
 
 def _print_fault_report(result: dict) -> None:
@@ -903,7 +944,13 @@ def main(argv=None) -> int:
                 from .serve.batching import BatchingConfig, ContinuousBatcher
                 from .serve.frontend import Request
 
-                bcfg = BatchingConfig(**params_json["batching"])
+                prefix_kw = {}
+                if "prefix_cache" in params_json:
+                    from .models.paged_kv import PrefixCacheConfig
+
+                    prefix_kw = dict(prefix_cache=PrefixCacheConfig(
+                        **params_json["prefix_cache"]))
+                bcfg = BatchingConfig(**params_json["batching"], **prefix_kw)
                 split_kw = {}
                 if rt is not None:
                     split_kw = dict(split_runtime=rt,
@@ -920,12 +967,21 @@ def main(argv=None) -> int:
                 rng = np.random.default_rng(soak.seed)
                 gaps = rng.exponential(1.0 / soak.arrival_rate,
                                        size=soak.n_requests)
+                # with shared_prefix_len every request opens with the SAME
+                # seeded token block (a system prompt) — the workload the
+                # prefix index turns into mapped pages instead of prefill
+                shared_pfx = (rng.integers(
+                    1, cfg.vocab_size,
+                    size=soak.shared_prefix_len).astype(np.int32)
+                    if soak.shared_prefix_len else None)
                 for i in range(soak.n_requests):
                     clock.advance(float(gaps[i]))
+                    pi = rng.integers(1, cfg.vocab_size,
+                                      size=soak.prompt_len).astype(np.int32)
+                    if shared_pfx is not None:
+                        pi[:soak.shared_prefix_len] = shared_pfx
                     front.submit(Request(
-                        prompt_ids=rng.integers(
-                            1, cfg.vocab_size,
-                            size=soak.prompt_len).astype(np.int32),
+                        prompt_ids=pi,
                         max_new_tokens=soak.max_new_tokens,
                         temperature=soak.temperature,
                         deadline_s=soak.deadline_s, rng_seed=i))
@@ -941,6 +997,7 @@ def main(argv=None) -> int:
                             "records": [r.as_dict() for r in records]}
                 with open(out("serve_report.json"), "w") as f:
                     json.dump(artifact, f, indent=1, default=float)
+                pf = rep.get("prefix")
                 print(json.dumps({
                     "requests": len(records), "outcomes": outcomes,
                     "mode": artifact["mode"],
@@ -949,9 +1006,20 @@ def main(argv=None) -> int:
                     "occupancy_mean": round(rep["alloc_util_mean"], 4),
                     "decode_tokens_per_s": round(
                         rep["decode_tokens_per_s"], 3),
+                    **({"prefix_hit_rate": round(pf["hit_rate"], 4),
+                        "prefill_tokens_saved": pf["saved_tokens"]}
+                       if pf else {}),
                     "artifact": out("serve_report.json")}))
                 if args.serve_report:
                     _print_serve_report(front.report())
+                if pf and soak.shared_prefix_len and not pf["hits"]:
+                    # the config promised a shared system prompt: an index
+                    # that never hit means the sharing plane is broken, not
+                    # that the workload had nothing to share
+                    raise SystemExit(
+                        f"prefix cache enabled with shared_prefix_len="
+                        f"{soak.shared_prefix_len} but the radix index "
+                        f"never hit: {pf}")
                 return 0
             spec = None
             if "speculative" in params_json:

@@ -33,6 +33,14 @@ module schedules many streams through ONE jitted decode step built on
   :class:`~edgellm_tpu.serve.recovery.Watchdog` guards wedged steps with the
   same typed :class:`~edgellm_tpu.serve.recovery.DecodeTimeout` the serving
   front already handles;
+- a :class:`~edgellm_tpu.models.paged_kv.PrefixCacheConfig` on the
+  ``BatchingConfig`` turns on prefix sharing: fresh admits consult a radix
+  index of token blocks, map every matched page into the new slot's table
+  with ZERO prefill compute (only the unmatched suffix runs, through
+  ``decode._prefill_suffix_jit``), and the first in-place write to a shared
+  page copy-on-write-forks it; refcount-0 index pages are reclaimed
+  LRU-first under pool pressure. Decode output stays token-identical to the
+  non-shared path (same pages, same attention span — different bookkeeping);
 - passing ``split_runtime=``/``placed_params=`` drives the SAME scheduler
   through ``SplitRuntime.decode_step_paged`` instead of the local pool: the
   host-side :class:`~edgellm_tpu.models.paged_kv.PagedKVCache` runs in
@@ -61,12 +69,13 @@ import jax.numpy as jnp
 
 from ..models.configs import ModelConfig
 from ..models.paged_kv import OutOfPages, OutOfSlots, PagedKVCache, \
-    paged_decode_step
+    PrefixCacheConfig, paged_decode_step
+from ..models.transformer import KVCache
 from ..obs import context as obs_context
 from ..obs.flight import flight_dump_for
 from ..obs.tracing import span as obs_span
 from ..utils.concurrency import guarded_by
-from .decode import _prefill_jit, _sample
+from .decode import _prefill_jit, _prefill_suffix_jit, _sample
 from .recovery import CheckpointError, DecodeCheckpoint, Watchdog
 
 
@@ -90,6 +99,10 @@ class BatchingConfig:
     cache_dtype: Any = jnp.float32
     checkpoint_dir: Optional[str] = None
     step_deadline_s: Optional[float] = None
+    # prefix sharing: a PrefixCacheConfig turns on the radix prefix index +
+    # copy-on-write pages (models.paged_kv); None = pre-sharing behavior,
+    # bit-for-bit (the batching.prefix-disabled-identity graphlint contract)
+    prefix_cache: Optional[PrefixCacheConfig] = None
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -105,6 +118,11 @@ class BatchingConfig:
                 f"pages_per_slot must be >= 1, got {self.pages_per_slot}")
         if self.step_deadline_s is not None and self.step_deadline_s <= 0:
             raise ValueError("step_deadline_s must be positive")
+        if self.prefix_cache is not None and not isinstance(
+                self.prefix_cache, PrefixCacheConfig):
+            raise ValueError(
+                f"prefix_cache must be a PrefixCacheConfig or None, got "
+                f"{type(self.prefix_cache).__name__}")
 
     @property
     def span(self) -> int:
@@ -223,7 +241,8 @@ class ContinuousBatcher:
             page_size=self.bcfg.page_size, max_slots=self.bcfg.max_slots,
             pages_per_slot=self.bcfg.pages_per_slot,
             dtype=self.bcfg.cache_dtype,
-            materialize=split_runtime is None)
+            materialize=split_runtime is None,
+            prefix_cache=self.bcfg.prefix_cache)
         self._split_pool = (
             split_runtime.init_paged_pool(self.bcfg.num_pages,
                                           self.bcfg.page_size,
@@ -326,52 +345,35 @@ class ContinuousBatcher:
         st = self._streams[sid]
         need_len = (int(st.resume["length"]) if st.resume is not None
                     else st.prompt.size)
-        if self.pool.pages_for(need_len + 1) > self.pool.num_free_pages:
-            return False  # +1: the admitting step itself must be coverable
+        # feasibility: +1 because the admitting step itself must be
+        # coverable. Prefix sharing shrinks the bill — indexed pages map in
+        # for free (minus one fork page when the match ends mid-page) — and
+        # index-only pages count as available (``ensure`` reclaims them
+        # LRU-first under pressure), which is exactly where the
+        # more-admits-at-fixed-pool capacity win comes from.
+        need_pages = self.pool.pages_for(need_len + 1)
+        if st.resume is None and self.pool.prefix is not None:
+            pr = self.pool.probe_prefix(st.prompt,
+                                        max_tokens=st.prompt.size - 1)
+            need_pages = need_pages - pr["pages"] + pr["forks"]
+        if need_pages > (self.pool.num_free_pages
+                         + self.pool.reclaimable_index_pages):
+            return False
         try:
             slot = self.pool.alloc_slot()
         except OutOfSlots:
             return False
         resumed = st.resume is not None
         t0 = time.monotonic()
-        if st.resume is not None:
-            if self.rt is not None:
-                self.pool.ensure(slot, need_len)
-                dest = self.pool._flat_indices(slot, need_len)
-                self._split_pool = self.rt.adopt_paged_rows(
-                    self._split_pool, st.resume["k"], st.resume["v"], dest)
-                self.pool.lengths[slot] = need_len
-            else:
-                self.pool.adopt(slot, jnp.asarray(st.resume["k"]),
-                                jnp.asarray(st.resume["v"]), need_len)
-            st.resume = None
-        else:
-            s = st.prompt.size
-            if self.rt is not None:
-                # the exact generate_split() prefill: same executable, same
-                # token-0 key, then the per-stage cache rows scatter into the
-                # mesh pools at this slot's pages
-                logits, cache = self.rt.prefill_decode(
-                    self.placed, jnp.asarray(st.prompt[None, :]),
-                    self.bcfg.span)
-                tok0 = _sample(logits[:, -1], jax.random.fold_in(st.key, 0),
-                               st.temperature)
-                self.pool.ensure(slot, s)
-                dest = self.pool._flat_indices(slot, s)
-                self._split_pool = self.rt.adopt_paged(
-                    self._split_pool, cache, 0, dest, s)
-                self.pool.lengths[slot] = s
-            else:
-                # the exact generate() prefill: same executable, same
-                # capacity semantics (KV values are capacity-invariant),
-                # same token-0 key
-                last_logits, cache = _prefill_jit(
-                    self.cfg, self.params, jnp.asarray(st.prompt[None, :]),
-                    self.bcfg.span, self.bcfg.compute_dtype)
-                tok0 = _sample(last_logits, jax.random.fold_in(st.key, 0),
-                               st.temperature)
-                self.pool.adopt(slot, cache.k[:, 0, :s], cache.v[:, 0, :s],
-                                s)
+        try:
+            tok0 = self._admit_fill(st, slot)
+        except OutOfPages:
+            # the feasibility probe over-promised (an interior index page
+            # can be unreclaimable while a descendant is slot-held): undo
+            # cleanly — nothing was committed to the stream yet
+            self.pool.free_slot(slot)
+            return False
+        if tok0 is not None:
             st.tokens.append(int(np.asarray(tok0)[0]))
         with self._stats_lock:
             self.stats["prefill_s"] += time.monotonic() - t0
@@ -387,6 +389,131 @@ class ContinuousBatcher:
         if st.t >= st.max_new_tokens:  # max_new_tokens == 1: prefill is all
             self._finish(st)
         return True
+
+    def _admit_fill(self, st: Stream, slot: int) -> Optional[jax.Array]:
+        """Land one stream's KV into ``slot``'s pages — resume payload,
+        full prefill, or (on a prefix-index hit) shared pages plus a
+        suffix-only prefill. Returns the sampled token 0 for fresh admits,
+        None for resumes. Raises :class:`OutOfPages` with the slot still
+        consistent (the caller undoes via ``free_slot``)."""
+        if st.resume is not None:
+            need_len = int(st.resume["length"])
+            # resumes adopt privately: the payload mixes prompt and
+            # generated rows, so re-sharing would index decode output
+            if self.rt is not None:
+                self.pool.ensure(slot, need_len)
+                dest = self.pool._flat_indices(slot, need_len)
+                self._split_pool = self.rt.adopt_paged_rows(
+                    self._split_pool, st.resume["k"], st.resume["v"], dest)
+                self.pool.lengths[slot] = need_len
+            else:
+                self.pool.adopt(slot, jnp.asarray(st.resume["k"]),
+                                jnp.asarray(st.resume["v"]), need_len)
+            st.resume = None
+            return None
+        s = st.prompt.size
+        matched = 0
+        if self.pool.prefix is not None:
+            # claim at most s-1 positions: at least one suffix token must
+            # run so token 0 has logits to sample from
+            matched = self.pool.share_prefix(slot, st.prompt,
+                                             max_tokens=s - 1)
+        if matched > 0:
+            tok0 = (self._prefill_suffix_split(st, slot, matched) if
+                    self.rt is not None else
+                    self._prefill_suffix_local(st, slot, matched))
+        elif self.rt is not None:
+            # the exact generate_split() prefill: same executable, same
+            # token-0 key, then the per-stage cache rows scatter into the
+            # mesh pools at this slot's pages
+            logits, cache = self.rt.prefill_decode(
+                self.placed, jnp.asarray(st.prompt[None, :]),
+                self.bcfg.span)
+            tok0 = _sample(logits[:, -1], jax.random.fold_in(st.key, 0),
+                           st.temperature)
+            self.pool.ensure(slot, s)
+            dest = self.pool._flat_indices(slot, s)
+            self._split_pool = self.rt.adopt_paged(
+                self._split_pool, cache, 0, dest, s)
+            self.pool.lengths[slot] = s
+        else:
+            # the exact generate() prefill: same executable, same
+            # capacity semantics (KV values are capacity-invariant),
+            # same token-0 key
+            last_logits, cache = _prefill_jit(
+                self.cfg, self.params, jnp.asarray(st.prompt[None, :]),
+                self.bcfg.span, self.bcfg.compute_dtype)
+            tok0 = _sample(last_logits, jax.random.fold_in(st.key, 0),
+                           st.temperature)
+            self.pool.adopt(slot, cache.k[:, 0, :s], cache.v[:, 0, :s], s)
+        if self.pool.prefix is not None:
+            # publish this prompt's pages (full blocks + partial tail) so
+            # later admits share them; already-indexed blocks just refresh
+            # their LRU stamps
+            self.pool.register_prefix(slot, st.prompt)
+        return tok0
+
+    def _prefill_suffix_local(self, st: Stream, slot: int,
+                              matched: int) -> jax.Array:
+        """Prefix-hit admit, local pool: the ``matched`` shared rows are
+        already mapped into ``slot``; gather them into a contiguous cache,
+        run ``decode._prefill_suffix_jit`` over ONLY the unmatched suffix,
+        and scatter the new rows back (COW-forking the shared tail page).
+        Token 0 uses the same ``fold_in(key, 0)`` as the full-prefill path —
+        parity with it is the executed ``batching.prefix-token-identity``
+        contract."""
+        s = st.prompt.size
+        state = self.pool.gather_slot(slot)  # the matched prefix rows
+        cdtype = (self.bcfg.compute_dtype if self.bcfg.compute_dtype
+                  is not None else jnp.float32)
+        nl, _, kv, hd = state["k"].shape
+        kc = jnp.zeros((nl, 1, self.bcfg.span, kv, hd), cdtype)
+        vc = jnp.zeros_like(kc)
+        cache = KVCache(kc.at[:, 0, :matched].set(state["k"]),
+                        vc.at[:, 0, :matched].set(state["v"]),
+                        jnp.asarray(matched, jnp.int32))
+        logits, cache = _prefill_suffix_jit(
+            self.cfg, self.params, jnp.asarray(st.prompt[None, matched:]),
+            cache, self.bcfg.compute_dtype)
+        tok0 = _sample(logits[:, -1], jax.random.fold_in(st.key, 0),
+                       st.temperature)
+        self.pool.adopt_rows(slot, cache.k[:, 0, matched:s],
+                             cache.v[:, 0, matched:s], matched, s)
+        return tok0
+
+    def _prefill_suffix_split(self, st: Stream, slot: int,
+                              matched: int) -> jax.Array:
+        """The split twin of :meth:`_prefill_suffix_local`: gather the
+        matched rows from the per-stage pools, run the runtime's
+        ``verify_step`` (the K-position split pass — B=1, sequential
+        schedule) over the suffix tokens, apply the COW fork copies to the
+        mesh pools, and scatter the suffix rows into this slot's pages."""
+        s = st.prompt.size
+        idx = self.pool._flat_indices(slot, matched)
+        k_seq, v_seq = self.rt.gather_paged(self._split_pool, idx)
+        ns, sz = k_seq.shape[:2]
+        kv, hd = k_seq.shape[3:]
+        kc = np.zeros((ns, sz, 1, self.bcfg.span, kv, hd), k_seq.dtype)
+        vc = np.zeros_like(kc)
+        kc[:, :, 0, :matched] = k_seq
+        vc[:, :, 0, :matched] = v_seq
+        cache = {"k": jnp.asarray(kc), "v": jnp.asarray(vc),
+                 "length": jnp.asarray(matched, jnp.int32)}
+        logits, cache = self.rt.verify_step(
+            self.placed, cache, jnp.asarray(st.prompt[None, matched:]))
+        tok0 = _sample(logits[:, -1], jax.random.fold_in(st.key, 0),
+                       st.temperature)
+        pairs = self.pool.ensure_writable(slot, s)  # bookkeeping-only forks
+        if pairs:
+            self._split_pool = self.rt.copy_paged_pages(
+                self._split_pool, [o for o, _ in pairs],
+                [n for _, n in pairs])
+        dest = self.pool._flat_indices(slot, s)[matched:]
+        self._split_pool = self.rt.adopt_paged_rows(
+            self._split_pool, cache["k"][:, :, 0, matched:s],
+            cache["v"][:, :, 0, matched:s], dest)
+        self.pool.lengths[slot] = s
+        return tok0
 
     def _gather_state(self, slot: int) -> dict:
         """One slot's contiguous K/V prefix as the resume/checkpoint payload.
@@ -450,6 +577,18 @@ class ContinuousBatcher:
     def _running(self) -> list[Stream]:
         return [self._streams[sid] for sid in self._slot_to_sid.values()]
 
+    def _grow_writable(self, st: Stream) -> None:
+        """Cover this step's write position for ``st`` — allocate growth
+        pages AND copy-on-write any shared page the position lands in (the
+        first decode write after a prefix-sharing admit forks the shared
+        tail page here). With sharing off this is exactly ``pool.ensure``."""
+        pairs = self.pool.ensure_writable(st.slot, self._cache_len(st) + 1)
+        if pairs and self.rt is not None:
+            # bookkeeping-only pool: route the fork copies to the mesh pools
+            self._split_pool = self.rt.copy_paged_pages(
+                self._split_pool, [o for o, _ in pairs],
+                [n for _, n in pairs])
+
     def _step_cache_size(self) -> int:
         """Executables behind this batcher's ragged step — local: the fused
         step+sample jit; split: the runtime's per-geometry paged step plus
@@ -481,17 +620,20 @@ class ContinuousBatcher:
             if st.status != "running":
                 continue  # already evicted by a predecessor's growth
             try:
-                self.pool.ensure(st.slot, self._cache_len(st) + 1)
+                self._grow_writable(st)
             except OutOfPages as e:
-                need = self.pool.pages_for(self._cache_len(st) + 1) \
-                    - len(self.pool._slot_pages[st.slot])
+                # a growth may need a fresh page (pages_for grew) OR a COW
+                # fork page (the write position sits in a shared page) —
+                # either way at least one page must come free
+                need = max(1, self.pool.pages_for(self._cache_len(st) + 1)
+                           - len(self.pool._slot_pages[st.slot]))
                 if not self._evict_for_pages(need, {st.sid}):
                     # unservable growth: capture the pool state post-mortem
                     # before the scheduler unwinds (once per instance)
                     flight_dump_for(e, sid=st.sid, slot=st.slot,
                                     free_pages=self.pool.num_free_pages)
                     raise
-                self.pool.ensure(st.slot, self._cache_len(st) + 1)
+                self._grow_writable(st)
         running = self._running()
         if not running:
             return 0
@@ -551,14 +693,19 @@ class ContinuousBatcher:
             advanced += 1
             if st.t >= st.max_new_tokens:
                 self._finish(st)
-        occ = self.pool.live_tokens / self.pool.token_capacity
+        # unique_live_tokens counts each physical page once: with prefix
+        # sharing, summing per-slot lengths would over-count aliased pages
+        # against a reserved-capacity denominator that holds them once
+        # (identical to live_tokens when nothing is shared)
+        occ = self.pool.unique_live_tokens / self.pool.token_capacity
         slot_util = len(self._slot_to_sid) / b
         # live tokens per RESERVED token — the denominator is only the pages
         # actually allocated, the paged answer to static batching's
         # worst-case (batch x capacity) reservation
         reserved = (self.pool.num_pages - 1
                     - self.pool.num_free_pages) * self.pool.page_size
-        alloc_util = self.pool.live_tokens / reserved if reserved else None
+        alloc_util = (self.pool.unique_live_tokens / reserved
+                      if reserved else None)
         with self._stats_lock:
             self.stats["occ_sum"] += occ
             self.stats["occ_max"] = max(self.stats["occ_max"], occ)
@@ -693,4 +840,6 @@ class ContinuousBatcher:
                                 if alloc_n else 0.0),
             "span": self.bcfg.span,
             "token_capacity": self.pool.token_capacity,
+            **({"prefix": self.pool.prefix_report()}
+               if self.pool.prefix is not None else {}),
         }

@@ -41,8 +41,10 @@ from jax.typing import ArrayLike
 
 from ..lint import graph_contract
 from ..models.configs import ModelConfig
-from ..models.transformer import (KVCache, cache_from_state_dict,
-                                  cache_state_dict, decode_step, prefill)
+from ..models.transformer import (KVCache, _cast_params, block_verify,
+                                  cache_from_state_dict, cache_state_dict,
+                                  decode_step, embed, precompute_rope,
+                                  prefill, unembed)
 from ..obs.latency import LatencyObserver
 from ..obs.metrics import (CounterSource, get_registry, record_decode_stats,
                            record_link_counters, record_link_health,
@@ -72,6 +74,40 @@ def _prefill_impl(cfg: ModelConfig, params: dict, prompt_ids: jnp.ndarray,
     return logits[:, -1], cache  # only the last position seeds generation
 
 
+@graph_contract("decode.prefill_suffix", collectives={},
+                donate=lambda ctx: ctx.get("donate_min", 2))
+def _prefill_suffix_impl(cfg: ModelConfig, params: dict,
+                         suffix_ids: jnp.ndarray, cache: KVCache,
+                         compute_dtype: Optional[Any]
+                         ) -> tuple[jnp.ndarray, KVCache]:
+    """Prefill ONLY the unmatched suffix of a prompt whose prefix KV rows
+    are already in ``cache`` (rows ``0 .. cache.length`` — gathered from
+    shared pages by the prefix-cache admit path). A K-position twin of
+    ``decode_step``: embed the (B, K) suffix, rotate at the absolute
+    positions ``cache.length .. cache.length+K-1``, scan ``block_verify``
+    over the layers (write K rows, attend causally against the filled
+    prefix), and return ((B, K, V) fp32 logits, cache grown by K). Compiled
+    once per (batch, K, capacity) shape — the admit path's analogue of the
+    one-executable-per-geometry rule."""
+    params = _cast_params(params, compute_dtype)
+    hidden = embed(params, suffix_ids)  # (B, K, D)
+    pos = cache.length
+    kq = suffix_ids.shape[1]
+    cos, sin = precompute_rope(cfg, cache.capacity)
+    cos_t = jax.lax.dynamic_slice_in_dim(cos, pos, kq)
+    sin_t = jax.lax.dynamic_slice_in_dim(sin, pos, kq)
+
+    def body(h, xs):
+        lp, kc, vc = xs
+        h, kc, vc = block_verify(cfg, lp, h, cos_t, sin_t, kc, vc, pos)
+        return h, (kc, vc)
+
+    hidden, (k_new, v_new) = jax.lax.scan(
+        body, hidden, (params["layers"], cache.k, cache.v))
+    logits = unembed(cfg, params, hidden)  # (B, K, V) fp32
+    return logits, KVCache(k_new, v_new, pos + kq)
+
+
 @graph_contract("decode.step", collectives={},
                 donate=lambda ctx: ctx.get("donate_min", 2))
 def _step_impl(cfg: ModelConfig, params: dict, cache: KVCache,
@@ -84,6 +120,12 @@ def _step_impl(cfg: ModelConfig, params: dict, cache: KVCache,
 
 _prefill_jit = jax.jit(_prefill_impl,
                        static_argnames=("cfg", "capacity", "compute_dtype"))
+# suffix prefill donates its cache: the gathered shared-prefix rows flow in,
+# the suffix rows land in place. One executable per (batch, K, capacity);
+# like full prefill, its compiles are NOT counted as step-cache jit misses.
+_prefill_suffix_jit = jax.jit(_prefill_suffix_impl,
+                              static_argnames=("cfg", "compute_dtype"),
+                              donate_argnums=(3,))
 # the cache is donated: each step's (B, capacity) KV buffers alias the previous
 # step's in the lowered executable instead of being copied per token (the
 # "decode.step" graph contract asserts the aliasing survives)

@@ -58,7 +58,7 @@ import jax.numpy as jnp
 
 from ..obs import context as obs_context
 from ..obs.flight import flight_dump_for, get_flight_recorder
-from ..obs.metrics import get_registry
+from ..obs.metrics import get_registry, record_prefix_stats
 from ..obs.server import ObsServer
 from ..obs.tracing import span as obs_span
 from ..utils.clock import MONOTONIC, Clock
@@ -499,6 +499,15 @@ class ServeFront:
                 "page_size": self.batcher.bcfg.page_size,
                 "num_pages": self.batcher.bcfg.num_pages,
                 "max_slots": self.batcher.bcfg.max_slots}
+        if rep.get("prefix"):
+            # once per drain (the counters carry running totals); the plan
+            # carries the headline numbers so per-request records are
+            # self-describing in the soak log
+            record_prefix_stats(rep["prefix"])
+            plan["prefix"] = {
+                "hit_rate": rep["prefix"]["hit_rate"],
+                "saved_tokens": rep["prefix"]["saved_tokens"],
+                "shared_pages": rep["prefix"]["shared_pages"]}
         if getattr(self.batcher, "rt", None) is not None:
             # split-driven batcher: every ragged step crossed the boundary
             # through the quantized hop ladder — record the plan it ran on
@@ -857,6 +866,11 @@ class ServeFront:
                          for n, b in sorted(self._breakers.items())},
             "plans": {f"{b}x{c}": n
                       for (b, c), n in sorted(self._plans.items())},
+            # present only when this front drains a prefix-enabled batcher:
+            # the live radix-index scoreboard --serve-report prints
+            **({"prefix": self.batcher.pool.prefix_report()}
+               if (self.batcher is not None
+                   and self.batcher.pool.prefix is not None) else {}),
         }
 
     # -- live telemetry ----------------------------------------------------

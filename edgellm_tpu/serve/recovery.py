@@ -247,6 +247,12 @@ class DecodeCheckpoint:
     and shape — bit-exact round-trips for every dtype including bfloat16,
     with no pickle in the loop. The payload is framed by magic + version +
     length + CRC32, so restore never feeds a damaged file to the unflattener.
+
+    Stream snapshots (``ContinuousBatcher.checkpoint_stream``) store the
+    CONTIGUOUS KV prefix, never pages: a stream whose pages were
+    prefix-shared gathers to the same bytes as an unshared one, and restore
+    adopts the rows privately — sharing is re-established only by the
+    destination pool's own radix index, never carried by the checkpoint.
     """
 
     def __init__(self, arrays: dict, meta: dict):

@@ -66,6 +66,10 @@ class SoakConfig:
     arrival_rate: float = 2.0
     seed: int = 0
     prompt_len: int = 8
+    #: first N prompt tokens identical across every request (a seeded
+    #: "system prompt") — the workload shape a prefix-enabled batcher turns
+    #: into mapped pages instead of prefill compute; 0 = fully random
+    shared_prefix_len: int = 0
     max_new_tokens: int = 8
     deadline_s: Optional[float] = 60.0
     temperature: float = 0.7
@@ -89,6 +93,10 @@ class SoakConfig:
             raise ValueError("burst_end_frac must be >= burst_start_frac")
         if self.priority_levels < 1:
             raise ValueError("priority_levels must be >= 1")
+        if not 0 <= self.shared_prefix_len <= self.prompt_len:
+            raise ValueError(
+                f"shared_prefix_len must be in [0, prompt_len="
+                f"{self.prompt_len}], got {self.shared_prefix_len}")
 
 
 def _plan_key(plan: Optional[dict]) -> tuple:
@@ -180,6 +188,11 @@ def run_soak(front: ServeFront, soak: SoakConfig, *, clock: FakeClock,
         rng.exponential(1.0 / soak.arrival_rate, n))
     vocab = front.model_cfg.vocab_size
     prompts = rng.integers(0, vocab, (n, soak.prompt_len), dtype=np.int32)
+    if soak.shared_prefix_len:
+        # same seeded block opens every prompt (drawn AFTER the matrix so a
+        # shared_prefix_len of 0 replays byte-identical historical soaks)
+        prompts[:, :soak.shared_prefix_len] = rng.integers(
+            0, vocab, soak.shared_prefix_len, dtype=np.int32)
     priorities = rng.integers(0, soak.priority_levels, n)
 
     kill_idx = (int(n * soak.kill_at_frac)
