@@ -142,14 +142,31 @@ BENCH_PREFIX_SLOTS (default 6), BENCH_PREFIX_PAGE_SIZE (default 8),
 BENCH_PREFIX_PAGES, BENCH_PREFIX_SEED, plus the shared BENCH_MODEL /
 BENCH_DTYPE.
 
+BENCH_KVQ=1 switches to the KV-at-rest quantization workload (see
+``kvq_main``): one seeded Poisson trace served once per KV page tier (fp /
+int8_per_channel / int4_per_channel) at the SAME pool byte budget — the
+quantized tiers fit more pages into the budget, so peak admitted
+concurrency is the capacity multiplier, and ``run_kv_tier_eval`` measures
+each tier's PPL through the exact serving data path. The artifact records
+per-tier pool bytes, live-tokens-per-HBM-byte, peak concurrency, PPL delta
+vs fp, and jit_misses. Knobs: BENCH_KVQ_REQUESTS (default 24),
+BENCH_KVQ_RATE (default 8.0), BENCH_KVQ_PROMPT (default 24),
+BENCH_KVQ_TOKENS (default 8), BENCH_KVQ_SLOTS (default 6),
+BENCH_KVQ_PAGE_SIZE (default 8), BENCH_KVQ_POOL_BYTES, BENCH_KVQ_PPL_*
+(WINDOW/STRIDE/CHUNKS/BATCH), BENCH_KVQ_SEED, plus the shared BENCH_MODEL
+/ BENCH_DTYPE.
+
 BENCH_WIRE=1 switches to the fused boundary-hop workload (see
 ``wire_main``): every FUSED_CAPABLE codec crosses a real 2-stage boundary
 through the fused single-buffer wire hop AND the separate
 encode/ppermute/decode ladder; the receiver rows must be bit-identical,
 and on TPU the fused-vs-fallback roundtrip ratio is timed and recorded to
 the probe cache under ``fused_hop:<codec>`` (the measurement the plan gate
-requires). Knobs: BENCH_WIRE_BATCH / BENCH_WIRE_SEQ / BENCH_WIRE_DIM
-(default 8x512x896), BENCH_WIRE_ITERS (default 20).
+requires — and the artifact asserts no codec that WOULD be substituted
+into the default path times slower than its jnp ladder, so a regressed
+kernel is demoted before serving ever reuses it). Knobs: BENCH_WIRE_BATCH
+/ BENCH_WIRE_SEQ / BENCH_WIRE_DIM (default 8x512x896), BENCH_WIRE_ITERS
+(default 20).
 
 BENCH_SPEC=1 switches to the speculative split-decode workload (see
 ``spec_main``): vanilla ``generate_split`` (one boundary hop per token) vs
@@ -1667,6 +1684,185 @@ def prefix_main():
     _emit(line, detail)
 
 
+def kvq_main():
+    """BENCH_KVQ=1: KV-at-rest quantized pages, same trace per tier at a
+    FIXED pool byte budget.
+
+    ONE seeded Poisson arrival trace (the BENCH_PREFIX workload shape, no
+    prefix sharing so capacity attribution is purely the page tier), served
+    through the continuous batcher once per KV tier — ``fp``,
+    ``int8_per_channel``, ``int4_per_channel`` — with the pool sized to the
+    SAME HBM byte budget each time (``num_pages_for_bytes``: quantized rows
+    are smaller, so the same bytes hold more pages). Reports per tier:
+
+    - **peak admitted concurrency**: the capacity multiplier compression
+      buys at fixed memory (the CI gate requires int4 >= 2x fp);
+    - **PPL** via :func:`run_kv_tier_eval` on a seeded corpus — quality is
+      measured through the exact serving data path, never assumed (the CI
+      gate requires the int8 delta vs fp <= 1%);
+    - **jit_misses**: every tier must hold the jit-miss-free steady state;
+    - **pool bytes + live-tokens-per-HBM-byte**: the tracked capacity
+      numbers behind the multiplier claim (detail sidecar).
+
+    Knobs: BENCH_KVQ_REQUESTS (default 24), BENCH_KVQ_RATE (default 8.0,
+    saturating), BENCH_KVQ_PROMPT (default 24), BENCH_KVQ_TOKENS (default
+    8), BENCH_KVQ_SLOTS (default 6), BENCH_KVQ_PAGE_SIZE (default 8),
+    BENCH_KVQ_POOL_BYTES (default: the bytes of an fp pool holding HALF the
+    slots' exclusive reservation — the contended regime), BENCH_KVQ_PPL_*
+    (WINDOW default 96, STRIDE 48, CHUNKS 3, BATCH 3), BENCH_KVQ_SEED, plus
+    the shared BENCH_MODEL / BENCH_DTYPE."""
+    import jax
+    import jax.numpy as jnp
+    from edgellm_tpu.eval.split_eval import run_kv_tier_eval
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.models.paged_kv import (kv_page_bytes,
+                                             num_pages_for_bytes)
+    from edgellm_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    n_requests = int(os.environ.get("BENCH_KVQ_REQUESTS", "24"))
+    rate = float(os.environ.get("BENCH_KVQ_RATE", "8.0"))
+    prompt_len = int(os.environ.get("BENCH_KVQ_PROMPT", "24"))
+    tokens = int(os.environ.get("BENCH_KVQ_TOKENS", "8"))
+    slots = int(os.environ.get("BENCH_KVQ_SLOTS", "6"))
+    page_size = int(os.environ.get("BENCH_KVQ_PAGE_SIZE", "8"))
+    seed = int(os.environ.get("BENCH_KVQ_SEED", "0"))
+    ppl_window = int(os.environ.get("BENCH_KVQ_PPL_WINDOW", "96"))
+    ppl_stride = int(os.environ.get("BENCH_KVQ_PPL_STRIDE", "48"))
+    ppl_chunks = int(os.environ.get("BENCH_KVQ_PPL_CHUNKS", "3"))
+    ppl_batch = int(os.environ.get("BENCH_KVQ_PPL_BATCH", "3"))
+    tiers = ("fp", "int8_per_channel", "int4_per_channel")
+
+    span = prompt_len + tokens
+    pages_per_slot = -(-span // page_size)
+    # the KV cache is stored at the pool's cache_dtype (float32 default),
+    # independent of the compute dtype — size the byte budget off THAT
+    cache_dtype = jnp.float32
+    fp_page = kv_page_bytes(cfg, page_size, dtype=cache_dtype)
+    pool_bytes = int(os.environ.get(
+        "BENCH_KVQ_POOL_BYTES",
+        str((1 + (slots * pages_per_slot) // 2) * fp_page)))
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n_requests))
+    prompts = [rng.integers(1, cfg.vocab_size,
+                            size=prompt_len).astype(np.int32)
+               for _ in range(n_requests)]
+    ppl_corpus = rng.integers(
+        1, cfg.vocab_size,
+        size=ppl_window + ppl_stride * (ppl_chunks + 1)).astype(np.int32)
+
+    def drive(kv_codec, num_pages):
+        bat = ContinuousBatcher(cfg, params, BatchingConfig(
+            page_size=page_size, num_pages=num_pages, max_slots=slots,
+            pages_per_slot=pages_per_slot, compute_dtype=dtype,
+            kv_codec=kv_codec))
+        # warm every executable on a throwaway geometry twin so the traced
+        # run's jit_misses isolates steady-state recompiles
+        warm = ContinuousBatcher(cfg, params, bat.bcfg)
+        warm.submit(np.ones((prompt_len,), np.int32), 2, rng_seed=0)
+        warm.run()
+        sid_of: dict = {}
+        now, nxt, peak, peak_live = 0.0, 0, 0, 0
+        while nxt < n_requests or bat._slot_to_sid or bat._waiting:
+            while nxt < n_requests and arrivals[nxt] <= now:
+                sid = bat.submit(prompts[nxt], tokens, rng_seed=seed + nxt)
+                sid_of[sid] = nxt
+                nxt += 1
+            t0 = time.monotonic()
+            advanced = bat.step()
+            dt = time.monotonic() - t0
+            if advanced == 0:
+                if nxt >= n_requests:
+                    raise RuntimeError(
+                        "batcher wedged with no future arrivals")
+                now = max(now, arrivals[nxt])  # idle: jump to next arrival
+                continue
+            now += dt
+            peak = max(peak, len(bat._slot_to_sid))
+            peak_live = max(peak_live, sum(
+                int(bat.pool.lengths[s]) for s in bat._slot_to_sid))
+        bat.pool.check_invariants()
+        toks = {i: bat.results[sid].tolist() for sid, i in sid_of.items()}
+        return toks, bat.report(), peak, peak_live
+
+    rows = []
+    fp_toks = None
+    for tier in tiers:
+        tier_page = kv_page_bytes(cfg, page_size, kv_codec=tier,
+                                  dtype=cache_dtype)
+        num_pages = num_pages_for_bytes(cfg, pool_bytes, page_size,
+                                        kv_codec=tier, dtype=cache_dtype)
+        toks, rep, peak, peak_live = drive(tier, num_pages)
+        if tier == "fp":
+            fp_toks = toks
+        ppl = run_kv_tier_eval(cfg, params, ppl_corpus, kv_codec=tier,
+                               max_length=ppl_window, stride=ppl_stride,
+                               page_size=page_size, window_batch=ppl_batch,
+                               max_chunks=ppl_chunks, compute_dtype=dtype)
+        used_bytes = num_pages * tier_page
+        rows.append({
+            "kv_codec": tier,
+            "num_pages": num_pages,
+            "page_bytes": tier_page,
+            "pool_bytes": used_bytes,
+            "pool_bytes_budget": pool_bytes,
+            "capacity_tokens": (num_pages - 1) * page_size,
+            # the tracked capacity number: decode-live token rows the SAME
+            # byte budget can hold at this tier
+            "live_tokens_per_hbm_byte": ((num_pages - 1) * page_size
+                                         / used_bytes),
+            "peak_concurrent": peak,
+            "peak_live_tokens": peak_live,
+            "finished": rep["finished"],
+            "evicted": rep["evicted"],
+            "jit_misses": rep["jit_misses"],
+            "ppl": ppl["ppl"],
+            "ppl_n_tokens": ppl["n_tokens"],
+        })
+
+    base = rows[0]
+    for r in rows:
+        r["ppl_delta_vs_fp"] = (r["ppl"] - base["ppl"]) / base["ppl"]
+        r["concurrency_vs_fp"] = (r["peak_concurrent"]
+                                  / max(base["peak_concurrent"], 1))
+    # fp-tier tokens must match a second fp run bit-for-bit? stronger: the
+    # fp tier IS the pre-quantization path (graphlint pins that); here we
+    # record that every stream finished everywhere instead
+    int4 = rows[-1]
+    int8 = rows[1]
+    detail = {
+        "section": "kvq", "requests": n_requests, "rate": rate,
+        "seed": seed, "prompt_len": prompt_len, "tokens": tokens,
+        "slots": slots, "page_size": page_size,
+        "pages_per_slot": pages_per_slot,
+        "pool_bytes_budget": pool_bytes,
+        "ppl_eval": {"window": ppl_window, "stride": ppl_stride,
+                     "chunks": ppl_chunks, "window_batch": ppl_batch},
+        "tiers": rows,
+    }
+    line = {
+        "metric": (f"{model_name} KV-at-rest int4 capacity multiplier "
+                   f"({n_requests} reqs, {pool_bytes} pool bytes)"),
+        "value": round(int4["concurrency_vs_fp"], 2),
+        "unit": "x peak admitted concurrency vs fp",
+        "vs_baseline": None,  # the reference serves nothing — no KV pool
+        "peak_concurrent_fp": base["peak_concurrent"],
+        "peak_concurrent_int8": int8["peak_concurrent"],
+        "peak_concurrent_int4": int4["peak_concurrent"],
+        "ppl_fp": round(base["ppl"], 4),
+        "ppl_delta_int8": round(int8["ppl_delta_vs_fp"], 6),
+        "ppl_delta_int4": round(int4["ppl_delta_vs_fp"], 6),
+        "jit_misses": max(r["jit_misses"] for r in rows),
+        "all_finished": all(r["finished"] == n_requests for r in rows),
+    }
+    _emit(line, detail)
+
+
 def _open_loop_summary(arrivals, t_submit, t_first, t_done, token_stamps,
                        new_tokens) -> dict:
     """Shared latency/throughput rollup for one serve run on the virtual
@@ -1886,6 +2082,8 @@ def main():
         return _run_section("serve", serve_main)
     if os.environ.get("BENCH_PREFIX") == "1":
         return _run_section("prefix", prefix_main)
+    if os.environ.get("BENCH_KVQ") == "1":
+        return _run_section("kvq", kvq_main)
     if os.environ.get("BENCH_WIRE") == "1":
         return _run_section("wire", wire_main)
     if os.environ.get("BENCH_SPEC") == "1":
@@ -2023,20 +2221,32 @@ def wire_main():
     n_parity = sum(r["fused_equals_fallback"] for r in rows)
     speedups = [r["roundtrip_speedup_vs_jnp_raw"] for r in rows
                 if "roundtrip_speedup_vs_jnp_raw" in r]
+    # the kernel family must earn its keep: a codec the default path WOULD
+    # substitute (frozen win set or probed win) that times slower than its
+    # jnp ladder is a regression — demote it (drop it from the win set or
+    # let the probe cache record the loss) before serving reuses the kernel
+    slow_defaults = [r["codec"] for r in rows
+                     if r.get("default_substituted")
+                     and r.get("roundtrip_speedup_vs_jnp_raw", 1.0) < 1.0]
     detail = {"section": "wire", "backend": backend, "codecs": rows,
               "probe_cache_path": cache_path}
     if speedups:
         line = {"metric": "fused hop min speedup vs separate ladder",
                 "value": round(min(speedups), 3), "unit": "x",
                 "vs_baseline": None, "section": "wire",
-                "parity": f"{n_parity}/{len(rows)}"}
+                "parity": f"{n_parity}/{len(rows)}",
+                "slow_default_codecs": slow_defaults}
     else:
         line = {"metric": "fused hop parity (timing skipped off-TPU)",
                 "value": n_parity, "unit": f"of {len(rows)} codecs",
-                "vs_baseline": None, "section": "wire"}
+                "vs_baseline": None, "section": "wire",
+                "slow_default_codecs": slow_defaults}
     _emit(line, detail)
     assert n_parity == len(rows), \
         [r["codec"] for r in rows if not r["fused_equals_fallback"]]
+    assert not slow_defaults, \
+        (f"default-substituted codec(s) timed slower than the jnp ladder: "
+         f"{slow_defaults} — demote before serving reuses the kernel")
     return 0
 
 

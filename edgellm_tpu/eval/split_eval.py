@@ -712,3 +712,152 @@ def run_fault_sweep(
         res["fault_rate"] = float(r)
         out.append(res)
     return out
+
+
+def run_kv_tier_eval(
+    cfg: ModelConfig,
+    params,
+    token_ids: np.ndarray,
+    *,
+    kv_codec: str = "fp",
+    max_length: int,
+    stride: int,
+    page_size: int = 16,
+    window_batch: int = 4,
+    max_chunks: Optional[int] = None,
+    compute_dtype=None,
+    metrics_path: Optional[str] = None,
+    progress=None,
+) -> dict:
+    """Token-weighted sliding-window PPL with the KV cache held AT REST in
+    one ``kv_codec`` tier (models.paged_kv.KV_PAGE_CODECS).
+
+    The boundary sweep measures what wire compression costs; this measures
+    what PAGE compression costs, with the same window/stride/masking recipe
+    and the same token weighting, so the two curves are directly comparable.
+    Every window is teacher-force decoded through a paged pool one position
+    at a time — the exact serving data path (quantize-on-append, in-kernel
+    dequant attention), not a whole-window forward, so the PPL delta vs the
+    ``"fp"`` tier is the delta a served stream actually experiences. One
+    executable per (window_batch, window_length) group shape; full-length
+    groups all share one, the short corpus tail gets its own.
+    """
+    from ..models.paged_kv import resolve_kv_codec as _resolve_tier
+    from ..models.paged_kv import (kv_page_bytes, paged_decode_step,
+                                   paged_decode_step_quant)
+
+    codec = _resolve_tier(kv_codec)
+    quant = codec.quantized
+    L, KV, hd = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    fn_cache: dict = {}
+
+    def _make_fn(w, t, pps, num_pages):
+        def fn(p, pt, ids, targets):
+            if quant:
+                hdc = codec.code_lanes(hd)
+                pools = (jnp.zeros((L, num_pages, page_size, KV, hdc),
+                                   codec.code_dtype),
+                         jnp.zeros((L, num_pages, page_size, KV, hdc),
+                                   codec.code_dtype),
+                         jnp.zeros((L, num_pages, page_size, KV),
+                                   jnp.float32),
+                         jnp.zeros((L, num_pages, page_size, KV),
+                                   jnp.float32))
+            else:
+                pools = (jnp.zeros((L, num_pages, page_size, KV, hd),
+                                   jnp.float32),
+                         jnp.zeros((L, num_pages, page_size, KV, hd),
+                                   jnp.float32))
+
+            def body(pools_c, xs):
+                tok, tgt, step = xs
+                lengths = jnp.full((w,), step, jnp.int32)
+                if quant:
+                    logits, *pools2 = paged_decode_step_quant(
+                        cfg, p, *pools_c, pt, lengths, tok,
+                        kv_codec=codec.name, compute_dtype=compute_dtype)
+                else:
+                    logits, *pools2 = paged_decode_step(
+                        cfg, p, *pools_c, pt, lengths, tok,
+                        compute_dtype=compute_dtype)
+                logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+                valid = tgt != -100
+                safe = jnp.where(valid, tgt, 0)
+                nll = -jnp.take_along_axis(logp, safe[:, None], 1)[:, 0]
+                return tuple(pools2), (jnp.where(valid, nll, 0.0), valid)
+
+            # feed positions 0..t-2; the step-s logits score target s+1 —
+            # the same shift nll_from_logits applies to whole-window logits
+            xs = (ids[:, :-1].T, targets[:, 1:].T, jnp.arange(t - 1))
+            _, (nlls, valids) = jax.lax.scan(body, pools, xs)
+            return nlls.sum(0), valids.sum(0).astype(jnp.float32)
+        return jax.jit(fn)
+
+    total_nll, n_tokens, chunks = 0.0, 0.0, 0
+    t0 = time.perf_counter()
+    for group in _iter_window_groups(token_ids, max_length, stride,
+                                     window_batch=window_batch,
+                                     max_count=max_chunks):
+        ids = np.concatenate([c.input_ids for c in group])       # (W, T)
+        targets = np.concatenate([c.target_ids for c in group])
+        counts = np.array([c.num_loss_tokens for c in group], np.float64)
+        w, t = ids.shape
+        pps = -(-t // page_size)
+        num_pages = 1 + w * pps                  # page 0 stays the trash page
+        key = (w, t)
+        if key not in fn_cache:
+            fn_cache[key] = _make_fn(w, t, pps, num_pages)
+        pt = jnp.asarray(np.arange(1, num_pages, dtype=np.int32)
+                         .reshape(w, pps))
+        nll_sum, n_valid = fn_cache[key](params, pt, jnp.asarray(ids),
+                                         jnp.asarray(targets))
+        per_window = (np.asarray(nll_sum, np.float64)
+                      / np.maximum(np.asarray(n_valid, np.float64), 1.0))
+        total_nll += float(per_window @ counts)
+        n_tokens += float(counts.sum())
+        chunks += len(group)
+        if progress:
+            progress(group[-1].index)
+    wall = time.perf_counter() - t0
+    result = {
+        "kv_codec": codec.name,
+        "ppl": float(np.exp(total_nll / max(n_tokens, 1e-9))),
+        "total_nll": total_nll,
+        "n_tokens": n_tokens,
+        "chunks": chunks,
+        "wall_s": wall,
+        "page_size": page_size,
+        "window_batch": window_batch,
+        # bytes one page costs at this tier (all layers, K+V, codes+scales) —
+        # the capacity story: fp_bytes / tier_bytes pages fit per fp page
+        "kv_page_bytes": kv_page_bytes(cfg, page_size, kv_codec=codec.name),
+        "kv_page_bytes_fp": kv_page_bytes(cfg, page_size),
+    }
+    _emit(metrics_path, {"final": True, **{k: result[k] for k in
+                         ("kv_codec", "ppl", "n_tokens", "chunks", "wall_s",
+                          "kv_page_bytes")}})
+    return result
+
+
+def run_kv_tier_sweep(
+    cfg: ModelConfig,
+    params,
+    token_ids: np.ndarray,
+    *,
+    tiers: Sequence[str] = ("fp", "int8_per_channel", "int4_per_channel"),
+    **eval_kwargs,
+) -> list:
+    """PPL / page-bytes curve as a function of KV-at-rest tier.
+
+    Runs :func:`run_kv_tier_eval` once per entry of ``tiers`` — the KV twin
+    of :func:`run_fault_sweep`'s rate sweep, with the ``"fp"`` entry as the
+    exact baseline point (plain fp pages, the pre-quantization data path).
+    Each result gains ``ppl_delta_vs_fp`` when the sweep includes ``"fp"``.
+    """
+    out = [run_kv_tier_eval(cfg, params, token_ids, kv_codec=t, **eval_kwargs)
+           for t in tiers]
+    base = next((r["ppl"] for r in out if r["kv_codec"] == "fp"), None)
+    if base is not None:
+        for r in out:
+            r["ppl_delta_vs_fp"] = (r["ppl"] - base) / base
+    return out

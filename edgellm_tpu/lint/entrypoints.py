@@ -304,6 +304,133 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
         findings.append(_driver_error("batching.prefix-token-identity", e))
 
+    # ---- KV-at-rest quantization: the quant ragged step (collective-free;
+    # ---- all FOUR pool buffers — codes AND scales — stay donated in the
+    # ---- lowered executable) --------------------------------------------
+    qpool = paged_kv.init_quant_pool(cfg, NPG, PGS, "int8_per_channel")
+    qkeys = jnp.stack([jax.random.key(0)] * MS)
+    qsteps = jnp.zeros((MS,), jnp.int32)
+    qtemps = jnp.zeros((MS,), jnp.float32)
+    run_one("paged.decode_step_quant",
+            lambda p, pk, pv, ks, vs, pt, ln, t:
+                paged_kv.paged_decode_step_quant(
+                    cfg, p, pk, pv, ks, vs, pt, ln, t,
+                    kv_codec="int8_per_channel"),
+            (params, qpool.k, qpool.v, qpool.k_scale, qpool.v_scale, ptab,
+             plens, ptoks),
+            ctx={"donate_min": 4},
+            lowerable=batching._batched_step_quant_jit,
+            lower_args=(cfg, params, qpool.k, qpool.v, qpool.k_scale,
+                        qpool.v_scale, ptab, plens, ptoks, qkeys, qsteps,
+                        qtemps, "int8_per_channel", None))
+
+    # the fp tier must be a NO-OP: a kv_codec="fp" batcher with live state
+    # feeds the byte-identical ragged step graph the pre-quantization
+    # batcher traces — the disabled-build jaxpr fingerprint half of the
+    # KV-at-rest contract
+    try:
+        fbat = batching.ContinuousBatcher(
+            cfg, params, batching.BatchingConfig(
+                page_size=PGS, num_pages=NPG, max_slots=MS,
+                pages_per_slot=PPS, kv_codec="fp"))
+        fbat.submit(np.arange(1, 1 + SEQ, dtype=np.int32), 4,
+                    temperature=0.0, rng_seed=0)
+        fbat.step()
+        ftab, flens = fbat.pool.device_tables()
+        ftoks = jnp.zeros((MS,), jnp.int32)
+        ident = check_identity(
+            "batching.kvq-disabled-identity",
+            lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                cfg, p, pk, pv, pt, ln, t),
+            (params, fbat.pool.pool.k, fbat.pool.pool.v, ftab, flens, ftoks),
+            lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                cfg, p, pk, pv, pt, ln, t),
+            (params, ppool.k, ppool.v, ptab, plens, ptoks),
+            what="kv_codec=\"fp\" batcher's ragged decode-step graph")
+        (findings.extend(ident) if ident
+         else checked.append("batching.kvq-disabled-identity"))
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("batching.kvq-disabled-identity", e))
+
+    # ---- fp-tier token identity: an explicit kv_codec="fp" batcher must
+    # ---- emit token-for-token what direct generate() emits — the EXECUTED
+    # ---- half (quantize-on-append must never touch the fp path) ---------
+    try:
+        kbat = batching.ContinuousBatcher(
+            cfg, params, batching.BatchingConfig(
+                page_size=PGS, num_pages=NPG, max_slots=MS,
+                pages_per_slot=PPS, kv_codec="fp"))
+        kprompt = np.arange(1, 1 + SEQ, dtype=np.int32)
+        ksid = kbat.submit(kprompt, 6, temperature=0.0, rng_seed=0)
+        kgot = kbat.run()[ksid]
+        kref = np.asarray(serve_decode.generate(
+            cfg, params, kprompt[None], 6, capacity=CAPACITY,
+            rng_key=jax.random.key(0)))[0]
+        if not np.array_equal(kgot, kref):
+            findings.append(Finding(
+                layer="graph", rule="GC-identity",
+                where="batching.kvq-fp-token-identity", line=0,
+                message=f"fp-tier paged decode diverged from direct "
+                        f"generate: {kgot.tolist()} != {kref.tolist()}"))
+        else:
+            checked.append("batching.kvq-fp-token-identity")
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("batching.kvq-fp-token-identity", e))
+
+    # ---- quant decode fallback: the XLA page-table-gather path must equal
+    # ---- quantize->dequantize + plain decode attention EXACTLY (same op
+    # ---- order, no extra rounding) — executed on random packed pools ----
+    try:
+        from ..models import flash_attention as fa
+
+        eq_rng = np.random.default_rng(3)
+        for tier in ("int8_per_channel", "int4_per_channel"):
+            tpool = paged_kv.init_quant_pool(cfg, NPG, PGS, tier)
+            kq, ks = fa.quantize_kv_rows(jnp.asarray(
+                eq_rng.standard_normal((cfg.num_layers, NPG * PGS,
+                                        cfg.num_kv_heads, cfg.head_dim),
+                                       np.float32)), tier)
+            vq, vs = fa.quantize_kv_rows(jnp.asarray(
+                eq_rng.standard_normal((cfg.num_layers, NPG * PGS,
+                                        cfg.num_kv_heads, cfg.head_dim),
+                                       np.float32)), tier)
+            shp = tpool.k.shape
+            kq = kq.reshape(shp)
+            vq = vq.reshape(shp)
+            ks = ks.reshape(shp[:-1])
+            vs = vs.reshape(shp[:-1])
+            q = jnp.asarray(eq_rng.standard_normal(
+                (MS, 1, cfg.num_heads, cfg.head_dim), np.float32))
+            etab = jnp.asarray(
+                eq_rng.permutation(np.arange(1, NPG))[:MS * PPS]
+                .reshape(MS, PPS).astype(np.int32))
+            elens = jnp.asarray([PGS + 3, PGS - 2], jnp.int32)
+            got = fa.paged_decode_attention_quant(
+                q, kq[0], vq[0], ks[0], vs[0], etab, elens, kv_codec=tier)
+            # reference: dequantize the WHOLE pool, then the plain fp path
+            kf = fa.dequantize_kv_rows(
+                kq[0].reshape(NPG * PGS, cfg.num_kv_heads, -1),
+                ks[0].reshape(NPG * PGS, cfg.num_kv_heads), tier)
+            vf = fa.dequantize_kv_rows(
+                vq[0].reshape(NPG * PGS, cfg.num_kv_heads, -1),
+                vs[0].reshape(NPG * PGS, cfg.num_kv_heads), tier)
+            ref = fa.paged_decode_attention(
+                q, kf.reshape(NPG, PGS, cfg.num_kv_heads, cfg.head_dim),
+                vf.reshape(NPG, PGS, cfg.num_kv_heads, cfg.head_dim),
+                etab, elens)
+            if not np.array_equal(np.asarray(got), np.asarray(ref)):
+                d = float(np.abs(np.asarray(got) - np.asarray(ref)).max())
+                findings.append(Finding(
+                    layer="graph", rule="GC-identity",
+                    where="paged.quant-fallback-equivalence", line=0,
+                    message=f"{tier} XLA fallback diverged from quantize->"
+                            f"dequantize decode attention (max |d|={d:g})"))
+                break
+        else:
+            checked.append("paged.quant-fallback-equivalence")
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("paged.quant-fallback-equivalence", e))
+
     # ---- split pipeline: boundary hops over a real 2-stage mesh ---------
     if len(jax.devices()) < 2:
         skipped.append("split/fault contracts: needs >= 2 devices "

@@ -472,8 +472,65 @@ def causal_attention_stats(q, k, v, *, interpret: bool | None = None,
 # ---------------------------------------------------------------------------
 
 
+#: KV-at-rest storage tiers for the paged pool (models/paged_kv.py): pages
+#: hold packed int codes plus one fp32 scale per (token row, KV head), the
+#: same per-channel shapes the wire codecs compress — applied at rest.
+#: "fp" is the uncompressed tier and builds the exact pre-quantization graph.
+KV_REST_TIERS = ("fp", "int8_per_channel", "int4_per_channel")
+
+
+def _kv_quant_spec(kv_codec: str) -> float:
+    """Integer span of a quantized KV tier (codes live in [-qmax, qmax])."""
+    if kv_codec == "int8_per_channel":
+        return 127.0
+    if kv_codec == "int4_per_channel":
+        return 7.0
+    raise ValueError(f"unknown KV-at-rest tier {kv_codec!r}; quantized "
+                     f"options: {[t for t in KV_REST_TIERS if t != 'fp']}")
+
+
+def quantize_kv_rows(x, kv_codec: str):
+    """Quantize K or V rows per (token, KV head) over the ``hd`` lanes:
+    x (..., KV, hd) -> (codes, scales (..., KV) fp32).
+
+    The scale is each row's absmax — one fp32 per row per head, so a page
+    append touches only its own row's codes and scale (whole-page scales
+    would force a page requantize on every decode write). int8 codes are
+    (..., KV, hd) int8; int4 codes pack lane ``i`` with lane ``i + hd/2``
+    into one uint8 (..., KV, hd//2), the contiguous-half pairing the wire
+    codecs use. An all-zero row quantizes to zero codes with scale 0, which
+    dequantizes back to exact zeros (the trash page stays finite)."""
+    qmax = _kv_quant_spec(kv_codec)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    safe = jnp.where(amax > 0, amax, 1.0)
+    codes = jnp.round(xf / safe[..., None] * qmax).astype(jnp.int8)
+    if kv_codec == "int4_per_channel":
+        half = x.shape[-1] // 2
+        u = (codes + 8).astype(jnp.uint8)  # [-8, 7] -> [0, 15]
+        codes = u[..., :half] | (u[..., half:] << 4)
+    return codes, amax
+
+
+def dequantize_kv_rows(codes, scales, kv_codec: str, dtype=jnp.float32):
+    """Invert :func:`quantize_kv_rows`: codes (..., KV, hdc) + scales
+    (..., KV) -> (..., KV, hd) in ``dtype``. The XLA gather fallback and the
+    reference path of the numerical-equivalence contract both run exactly
+    this expression, so gather-then-dequantize equals dequantize-then-gather
+    bit for bit (the op is elementwise per row)."""
+    qmax = _kv_quant_spec(kv_codec)
+    if kv_codec == "int4_per_channel":
+        lo = (codes & 0xF).astype(jnp.int8) - 8
+        hi = ((codes >> 4) & 0xF).astype(jnp.int8) - 8
+        c = jnp.concatenate([lo, hi], axis=-1).astype(jnp.float32)
+    else:
+        c = codes.astype(jnp.float32)
+    return (c * (scales[..., None] / qmax)).astype(dtype)
+
+
 def decode_plan(capacity: int, h: int, kv: int, hd: int,
-                itemsize: int = 2, pages: tuple[int, int] | None = None):
+                itemsize: int = 2, pages: tuple[int, int] | None = None,
+                kv_codec: str | None = None):
     """Kernel plan for the q_len=1 decode shape — mirrors :func:`kernel_plan`
     so the probe-cache substitution policy carries over unchanged.
 
@@ -495,7 +552,16 @@ def decode_plan(capacity: int, h: int, kv: int, hd: int,
     ``EDGELLM_ATTN=pallas`` forces it on any backend (interpret mode off-TPU,
     which is how tier-1 exercises the kernel); ``EDGELLM_ATTN=xla`` forces
     the gather fallback. The ``itemsize`` scaling tracks the real
-    bytes-per-step the way the prefill gates do."""
+    bytes-per-step the way the prefill gates do.
+
+    ``kv_codec`` names a quantized at-rest tier (:data:`KV_REST_TIERS`): the
+    byte budget then counts the REAL per-row footprint (packed codes plus one
+    fp32 scale per KV head, per K and per V), the plan kind becomes
+    ``"paged_quant"`` (the in-kernel-dequant kernel), the probe-cache key is
+    per-tier (``paged_decode_attention.<tier>`` — a win measured for the fp
+    kernel says nothing about the dequant one), and on real silicon the page
+    size must tile the int8 sublane minimum (32; fp32 pages tile at 8 —
+    interpret mode has no tiling, so the forced-flag CI path keeps ps % 8)."""
     flag = os.environ.get("EDGELLM_ATTN")
     if flag == "xla":
         return None
@@ -504,23 +570,34 @@ def decode_plan(capacity: int, h: int, kv: int, hd: int,
     if pages is None:
         # no contiguous decode kernel validated: XLA fallback for all shapes
         return None
+    quant = kv_codec is not None and kv_codec != "fp"
+    if quant:
+        _kv_quant_spec(kv_codec)  # fail fast on an unknown tier name
+        if hd % 2:
+            return None  # int4 packing pairs lanes across hd/2
     pps, ps = pages
     if pps * ps != capacity:
         return None
     # page rows land in the sublane dim of the (ps, KV*hd) page block; keep
     # them register-aligned, and keep the span inside the validated window
-    if ps % 8 or capacity > MAX_BLOCKED_S:
+    align = 32 if quant and jax.default_backend() == "tpu" else 8
+    if ps % align or capacity > MAX_BLOCKED_S:
         return None
-    if 2 * capacity * kv * hd * itemsize > MAX_PAGED_KV_BYTES:
+    code_bytes = (hd * itemsize if not quant
+                  else (hd if kv_codec == "int8_per_channel" else hd // 2) + 4)
+    if 2 * capacity * kv * code_bytes > MAX_PAGED_KV_BYTES:
         return None
+    kind = ("paged_quant", (pps, ps)) if quant else ("paged", (pps, ps))
     if flag == "pallas":
-        return ("paged", (pps, ps))
+        return kind
     if jax.default_backend() != "tpu":
         return None
     from ..codecs import probe_cache
 
-    if probe_cache.measured_win("paged_decode_attention") is True:
-        return ("paged", (pps, ps))
+    probe_key = (f"paged_decode_attention.{kv_codec}" if quant
+                 else "paged_decode_attention")
+    if probe_cache.measured_win(probe_key) is True:
+        return kind
     return None
 
 
@@ -748,4 +825,166 @@ def paged_decode_attention(q, k_pages, v_pages, page_table, lengths):
            + jnp.arange(ps)[None, None, :]).reshape(b, span)
     kg = k_pages.reshape(pn * ps, kv, hd)[idx]
     vg = v_pages.reshape(pn * ps, kv, hd)[idx]
+    return decode_attention(q, kg, vg, lengths)
+
+
+def _paged_decode_quant_kernel(pt_ref, lens_ref, q_ref, k_ref, v_ref,
+                               ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr,
+                               *, hd, ps, pps, bits):
+    """Quantized-page twin of :func:`_paged_decode_kernel`: pages arrive as
+    packed int codes plus per-row scales and are dequantized IN VMEM, per
+    page, inside the grid step — decode never materializes an fp copy of the
+    pool in HBM. Two extra scalar-prefetch-indexed operands carry the
+    (page_size, KV) fp32 scale blocks for K and V; the BlockSpec index map is
+    the same ``pt[i*pps + j]`` page walk.
+
+    ``bits`` is static: 8 reads (ps, KV*hd) int8 codes directly; 4 reads
+    (ps, KV*hd/2) packed uint8 and splits nibbles with int32 shifts (lane i
+    pairs with lane i + hd/2, matching quantize_kv_rows), widening each
+    group's half-block to (ps, hd) before the dot. All dequant math and both
+    dots run in fp32 — the codes' dynamic range is tiny, and q may be a
+    different dtype than the pool."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    hdc = hd // 2 if bits == 4 else hd
+    kv = k_ref.shape[2] // hdc
+    h = q_ref.shape[1] // hd
+    rep = h // kv
+    length = lens_ref[i]
+    inv_qmax = 1.0 / (7.0 if bits == 4 else 127.0)
+
+    @pl.when(j == 0)
+    def _reset():
+        m_scr[...] = jnp.full(m_scr.shape, -jnp.inf, jnp.float32)
+        l_scr[...] = jnp.zeros(l_scr.shape, jnp.float32)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, jnp.float32)
+
+    @pl.when(j * ps < length)
+    def _compute():
+        pos = j * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        for g in range(kv):
+            kc = k_ref[0, :, g * hdc:(g + 1) * hdc]  # (ps, hdc) int codes
+            vc = v_ref[0, :, g * hdc:(g + 1) * hdc]
+            ksc = ks_ref[0, :, g:g + 1] * inv_qmax   # (ps, 1) fp32
+            vsc = vs_ref[0, :, g:g + 1] * inv_qmax
+            if bits == 4:
+                k32 = kc.astype(jnp.int32)
+                kq = jnp.concatenate(
+                    [(k32 & 0xF) - 8, ((k32 >> 4) & 0xF) - 8], axis=1)
+                v32 = vc.astype(jnp.int32)
+                vq = jnp.concatenate(
+                    [(v32 & 0xF) - 8, ((v32 >> 4) & 0xF) - 8], axis=1)
+            else:
+                kq = kc.astype(jnp.int32)
+                vq = vc.astype(jnp.int32)
+            k = kq.astype(jnp.float32) * ksc  # (ps, hd) dequantized
+            v = vq.astype(jnp.float32) * vsc
+            for r in range(rep):
+                hidx = g * rep + r
+                qh = q_ref[0, hidx * hd:(hidx + 1) * hd].reshape(1, hd)
+                s = jax.lax.dot_general(
+                    qh.astype(jnp.float32), k, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * (1.0 / np.sqrt(hd))
+                s = jnp.where(pos < length, s, -jnp.inf)
+                m_old = m_scr[hidx, 0]
+                m_new = jnp.maximum(m_old, jnp.max(s))
+                p = jnp.exp(s - m_new)
+                corr = jnp.exp(m_old - m_new)
+                m_scr[hidx, 0] = m_new
+                l_scr[hidx, 0] = l_scr[hidx, 0] * corr + jnp.sum(p)
+                pv = jax.lax.dot_general(
+                    p, v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                acc_scr[hidx, :] = acc_scr[hidx, :] * corr + pv[0]
+
+    @pl.when(j == pps - 1)
+    def _emit():
+        out = acc_scr[...] / l_scr[...]
+        o_ref[...] = out.reshape(1, h * hd).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("hd", "pps", "bits", "interpret"))
+def _paged_attn_quant(q2, kf, vf, ksf, vsf, pt_flat, lens, hd: int, pps: int,
+                      bits: int, interpret: bool):
+    """q2 (B, H*hd); kf/vf (num_pages, page_size, KV*hdc) packed codes;
+    ksf/vsf (num_pages, page_size, KV) fp32 scales; pt_flat (B*pps,) int32;
+    lens (B,) int32 -> (B, H*hd)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, dh = q2.shape
+    ps, kvc = kf.shape[1], kf.shape[2]
+    kv = ksf.shape[2]
+    h = dh // hd
+    page_map = lambda i, j, pt, ln: (pt[i * pps + j], 0, 0)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, pps),
+        in_specs=[
+            pl.BlockSpec((1, dh), lambda i, j, pt, ln: (i, 0)),
+            pl.BlockSpec((1, ps, kvc), page_map),
+            pl.BlockSpec((1, ps, kvc), page_map),
+            pl.BlockSpec((1, ps, kv), page_map),
+            pl.BlockSpec((1, ps, kv), page_map),
+        ],
+        out_specs=pl.BlockSpec((1, dh), lambda i, j, pt, ln: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, 1), jnp.float32),
+            pltpu.VMEM((h, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_decode_quant_kernel,
+                          hd=hd, ps=ps, pps=pps, bits=bits),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, dh), q2.dtype),
+        interpret=interpret,
+    )(pt_flat, lens, q2, kf, vf, ksf, vsf)
+
+
+def paged_decode_attention_quant(q, k_pages, v_pages, k_scale, v_scale,
+                                 page_table, lengths, *, kv_codec):
+    """Quantized-pool twin of :func:`paged_decode_attention`: k/v_pages hold
+    packed int codes (num_pages, page_size, KV, hdc) — hdc = hd for int8,
+    hd/2 for packed int4 — and k/v_scale (num_pages, page_size, KV) fp32
+    per-row absmax scales, the layout quantize_kv_rows writes. Dispatch is
+    the same plan gate with ``kv_codec`` (per-tier probe key); the Pallas
+    path dequantizes in VMEM, and the XLA fallback gathers codes+scales by
+    page table THEN dequantizes — elementwise per row, so it is exactly
+    equal to dequantizing the whole pool first (the numerical-equivalence
+    contract the lint layer executes)."""
+    b, s1, h, hd_q = q.shape
+    pn, ps, kv, hdc = k_pages.shape
+    hd = hdc * 2 if kv_codec == "int4_per_channel" else hdc
+    pps = page_table.shape[1]
+    span = pps * ps
+    if s1 != 1:
+        raise ValueError(f"paged decode is q_len=1 only, got q_len={s1}")
+    if hd != hd_q:
+        raise ValueError(f"code width {hdc} does not match q head_dim "
+                         f"{hd_q} for tier {kv_codec!r}")
+    if h % kv:
+        raise ValueError(f"ragged GQA: H={h}, KV={kv}")
+    plan = decode_plan(span, h, kv, hd,
+                       itemsize=jnp.dtype(q.dtype).itemsize,
+                       pages=(pps, ps), kv_codec=kv_codec)
+    if plan is not None:
+        bits = 4 if kv_codec == "int4_per_channel" else 8
+        q2 = q.reshape(b, h * hd)
+        kf = k_pages.reshape(pn, ps, kv * hdc)
+        vf = v_pages.reshape(pn, ps, kv * hdc)
+        out = _paged_attn_quant(q2, kf, vf, k_scale, v_scale,
+                                page_table.reshape(-1),
+                                lengths.astype(jnp.int32), hd, pps, bits,
+                                _use_interpret())
+        return out.reshape(b, 1, h, hd)
+    idx = (page_table[:, :, None] * ps
+           + jnp.arange(ps)[None, None, :]).reshape(b, span)
+    kg = dequantize_kv_rows(k_pages.reshape(pn * ps, kv, hdc)[idx],
+                            k_scale.reshape(pn * ps, kv)[idx],
+                            kv_codec, q.dtype)
+    vg = dequantize_kv_rows(v_pages.reshape(pn * ps, kv, hdc)[idx],
+                            v_scale.reshape(pn * ps, kv)[idx],
+                            kv_codec, q.dtype)
     return decode_attention(q, kg, vg, lengths)

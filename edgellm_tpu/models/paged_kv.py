@@ -310,6 +310,130 @@ def init_pool(cfg: ModelConfig, num_pages: int, page_size: int,
 
 
 # ---------------------------------------------------------------------------
+# KV-at-rest compression: quantized page layouts. ROADMAP item 3 — the same
+# per-channel shapes the wire codecs compress, applied to the pool so a fixed
+# HBM budget holds 2-4x more live tokens. The "fp" tier IS the plain PagePool
+# path above, untouched, so disabled builds trace the pre-quantization graph.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class KVPageCodec:
+    """One KV-at-rest storage tier. ``bits=0`` marks the uncompressed fp
+    tier (codes are the pool dtype itself, no scales). Quantized tiers store
+    ``code_lanes(hd)`` packed code bytes plus ONE fp32 absmax scale per
+    (token row, KV head) — per-row, not per-page, because decode appends a
+    single row via scatter and must not requantize its neighbours."""
+
+    name: str
+    bits: int
+    code_dtype: object  # jnp dtype of the code arrays ("fp": pool dtype)
+
+    @property
+    def quantized(self) -> bool:
+        return self.bits > 0
+
+    def code_lanes(self, head_dim: int) -> int:
+        """Last-axis width of a code row (int4 packs two lanes per byte)."""
+        if self.bits == 4:
+            if head_dim % 2:
+                raise ValueError(f"int4 packing needs an even head_dim, "
+                                 f"got {head_dim}")
+            return head_dim // 2
+        return head_dim
+
+    def row_bytes(self, head_dim: int, dtype=jnp.float32) -> int:
+        """HBM bytes per (token row, KV head) for K or V: codes + scale."""
+        if not self.quantized:
+            return head_dim * jnp.dtype(dtype).itemsize
+        return self.code_lanes(head_dim) + 4  # packed codes + fp32 scale
+
+
+KV_PAGE_CODECS = {
+    "fp": KVPageCodec("fp", 0, None),
+    "int8_per_channel": KVPageCodec("int8_per_channel", 8, jnp.int8),
+    "int4_per_channel": KVPageCodec("int4_per_channel", 4, jnp.uint8),
+}
+
+
+def resolve_kv_codec(name: str) -> KVPageCodec:
+    """Registry lookup that REFUSES unknown tier names (the run.py params
+    validator and every constructor route through this)."""
+    try:
+        return KV_PAGE_CODECS[name]
+    except KeyError:
+        raise ValueError(f"unknown kv_codec {name!r}; available tiers: "
+                         f"{sorted(KV_PAGE_CODECS)}") from None
+
+
+class QuantPagePool(NamedTuple):
+    """Quantized device pool: packed int codes + per-row fp32 scales.
+
+    k, v: (L, num_pages, page_size, KV, hdc) codes — hdc = hd (int8) or
+    hd/2 (packed int4, lane i paired with lane i + hd/2, the wire codecs'
+    contiguous-half pairing). k_scale, v_scale: (L, num_pages, page_size,
+    KV) fp32 absmax scales. Page axis 1 and token axis 2 match PagePool, so
+    the page-table/flat-index math is tier-agnostic."""
+
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: jnp.ndarray
+    v_scale: jnp.ndarray
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_quant_pool(cfg: ModelConfig, num_pages: int, page_size: int,
+                    kv_codec: str) -> QuantPagePool:
+    """All-zero quantized pool (same trash-page-0 convention as
+    :func:`init_pool`; zero codes with zero scales dequantize to zeros)."""
+    codec = resolve_kv_codec(kv_codec)
+    if not codec.quantized:
+        raise ValueError("init_quant_pool is for quantized tiers; "
+                         "use init_pool for fp")
+    if num_pages < 2:
+        raise ValueError(f"num_pages must be >= 2 (page 0 is reserved), "
+                         f"got {num_pages}")
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    hdc = codec.code_lanes(cfg.head_dim)
+    cshape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, hdc)
+    sshape = cshape[:-1]
+    return QuantPagePool(jnp.zeros(cshape, codec.code_dtype),
+                         jnp.zeros(cshape, codec.code_dtype),
+                         jnp.zeros(sshape, jnp.float32),
+                         jnp.zeros(sshape, jnp.float32))
+
+
+def kv_page_bytes(cfg: ModelConfig, page_size: int, kv_codec: str = "fp",
+                  dtype=jnp.float32) -> int:
+    """HBM bytes ONE page costs across all layers (K + V, codes + scales) —
+    the honest per-tier footprint the capacity accounting below divides by."""
+    codec = resolve_kv_codec(kv_codec)
+    return (2 * cfg.num_layers * page_size * cfg.num_kv_heads
+            * codec.row_bytes(cfg.head_dim, dtype))
+
+
+def num_pages_for_bytes(cfg: ModelConfig, pool_bytes: int, page_size: int,
+                        kv_codec: str = "fp", dtype=jnp.float32) -> int:
+    """Pages (trash page included) a fixed HBM budget buys at a tier — the
+    pages-per-token admission math is unchanged, the pool just has MORE
+    pages, which is exactly how quantization multiplies concurrency."""
+    pages = int(pool_bytes) // kv_page_bytes(cfg, page_size, kv_codec, dtype)
+    if pages < 2:
+        raise ValueError(
+            f"pool budget {pool_bytes} bytes buys {pages} {kv_codec} "
+            f"page(s); need >= 2 (page 0 is reserved)")
+    return pages
+
+
+# ---------------------------------------------------------------------------
 # jitted pool surgery: adopt a contiguous prefix, gather one back, permute
 # pages for defrag. All donate the pool so surgery is in-place.
 # ---------------------------------------------------------------------------
@@ -354,6 +478,85 @@ def _copy_pages_impl(pool_k, pool_v, src, dst):
             pool_v.at[:, dst].set(pool_v[:, src]))
 
 
+# Quantized-pool twins. Page moves (defrag, COW) are BYTE moves — codes and
+# scales ride the same permutation/copy untouched, so a forked page is
+# byte-identical to its original and defrag never requantizes. Only adopt
+# (fp rows in) and gather (fp rows out) touch the codec; the *_packed pair
+# moves raw codes+scales for the bit-exact checkpoint/eviction path.
+
+
+def _flat_rows_set(arr, dest, rows):
+    """Scatter (L, S, ...) rows into flat token positions ``dest`` (S,) of a
+    (L, num_pages, page_size, ...) pool array."""
+    l, pn, ps = arr.shape[:3]
+    tail = arr.shape[3:]
+    return (arr.reshape(l, pn * ps, *tail).at[:, dest]
+            .set(rows.astype(arr.dtype)).reshape(arr.shape))
+
+
+def _flat_rows_get(arr, idx):
+    l, pn, ps = arr.shape[:3]
+    tail = arr.shape[3:]
+    return arr.reshape(l, pn * ps, *tail)[:, idx]
+
+
+@functools.partial(jax.jit, static_argnames=("kv_codec",),
+                   donate_argnums=(0,))
+def _adopt_quant_impl(pool, k_seq, v_seq, dest, kv_codec: str):
+    """Quantize contiguous (L, S, KV, hd) fp K/V rows on append and scatter
+    codes + scales — 'writes quantize on append', the at-rest contract."""
+    from .flash_attention import quantize_kv_rows
+
+    qk, sk = quantize_kv_rows(k_seq, kv_codec)
+    qv, sv = quantize_kv_rows(v_seq, kv_codec)
+    return QuantPagePool(_flat_rows_set(pool.k, dest, qk),
+                         _flat_rows_set(pool.v, dest, qv),
+                         _flat_rows_set(pool.k_scale, dest, sk),
+                         _flat_rows_set(pool.v_scale, dest, sv))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _adopt_packed_impl(pool, k_codes, v_codes, k_scale, v_scale, dest):
+    """Scatter already-packed rows (a checkpoint's payload) — no requantize,
+    so restore is bit-exact by construction."""
+    return QuantPagePool(_flat_rows_set(pool.k, dest, k_codes),
+                         _flat_rows_set(pool.v, dest, v_codes),
+                         _flat_rows_set(pool.k_scale, dest, k_scale),
+                         _flat_rows_set(pool.v_scale, dest, v_scale))
+
+
+@jax.jit
+def _gather_packed_impl(pool, idx):
+    """Read rows back as packed codes + scales (checkpoint/eviction form —
+    geometry-independent AND codec-lossless)."""
+    return (_flat_rows_get(pool.k, idx), _flat_rows_get(pool.v, idx),
+            _flat_rows_get(pool.k_scale, idx),
+            _flat_rows_get(pool.v_scale, idx))
+
+
+@functools.partial(jax.jit, static_argnames=("kv_codec",))
+def _gather_quant_impl(pool, idx, kv_codec: str):
+    """Read rows back DEQUANTIZED to fp32 (the suffix-prefill compute path,
+    which needs fp rows; lossy by exactly the tier's quantization error)."""
+    from .flash_attention import dequantize_kv_rows
+
+    kc, vc, ks, vs = _gather_packed_impl(pool, idx)
+    return (dequantize_kv_rows(kc, ks, kv_codec),
+            dequantize_kv_rows(vc, vs, kv_codec))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _permute_pool_impl(arrays, src):
+    """Tier-agnostic defrag move over a tuple of pool arrays (page axis 1)."""
+    return tuple(a[:, src] for a in arrays)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_pool_pages_impl(arrays, src, dst):
+    """Tier-agnostic COW page copy over a tuple of pool arrays."""
+    return tuple(a.at[:, dst].set(a[:, src]) for a in arrays)
+
+
 class PagedKVCache:
     """Host-side allocator + device pool for up to ``max_slots`` concurrent
     streams of up to ``pages_per_slot * page_size`` tokens each.
@@ -369,19 +572,29 @@ class PagedKVCache:
     def __init__(self, cfg: ModelConfig, *, num_pages: int, page_size: int,
                  max_slots: int, pages_per_slot: int, dtype=jnp.float32,
                  materialize: bool = True,
-                 prefix_cache: Optional[PrefixCacheConfig] = None):
+                 prefix_cache: Optional[PrefixCacheConfig] = None,
+                 kv_codec: str = "fp"):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if pages_per_slot < 1:
             raise ValueError(
                 f"pages_per_slot must be >= 1, got {pages_per_slot}")
         self.cfg = cfg
+        # KV-at-rest tier. Every page bookkeeping path below (alloc, COW,
+        # refcounts, radix index, defrag permutation) is codec-agnostic — a
+        # page is a page; only the device-pool surgery dispatches on tier.
+        self.kv_codec = resolve_kv_codec(kv_codec).name
         # materialize=False: bookkeeping-only mode — the page table, free
         # list, and ownership machinery without a local device pool. The
         # split runtime uses this: its pools live per-stage on the mesh
         # (SplitRuntime.init_paged_pool), only the allocator is shared.
-        self.pool = (init_pool(cfg, num_pages, page_size, dtype)
-                     if materialize else None)
+        if not materialize:
+            self.pool = None
+        elif self.kv_codec == "fp":
+            self.pool = init_pool(cfg, num_pages, page_size, dtype)
+        else:
+            self.pool = init_quant_pool(cfg, num_pages, page_size,
+                                        self.kv_codec)
         self.page_size = page_size
         self.num_pages = num_pages
         self.max_slots = max_slots
@@ -784,11 +997,16 @@ class PagedKVCache:
         self.ensure(slot, new_length)
         pairs = self.prepare_write(slot, new_length)
         if pairs and self.pool is not None:
-            k, v = _copy_pages_impl(
-                self.pool.k, self.pool.v,
-                jnp.asarray([o for o, _ in pairs], jnp.int32),
-                jnp.asarray([n for _, n in pairs], jnp.int32))
-            self.pool = PagePool(k, v)
+            src = jnp.asarray([o for o, _ in pairs], jnp.int32)
+            dst = jnp.asarray([n for _, n in pairs], jnp.int32)
+            if self.kv_codec == "fp":
+                k, v = _copy_pages_impl(self.pool.k, self.pool.v, src, dst)
+                self.pool = PagePool(k, v)
+            else:
+                # byte move: the fork copies codes AND scales untouched, so
+                # the private page is byte-identical to the shared original
+                self.pool = QuantPagePool(
+                    *_copy_pool_pages_impl(tuple(self.pool), src, dst))
         return pairs
 
     def device_tables(self) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -820,8 +1038,13 @@ class PagedKVCache:
         self.ensure(slot, length)
         self.prepare_write(slot, length, start=0)
         dest = jnp.asarray(self._flat_indices(slot, length))
-        k, v = _adopt_impl(self.pool.k, self.pool.v, k_seq, v_seq, dest)
-        self.pool = PagePool(k, v)
+        if self.kv_codec == "fp":
+            k, v = _adopt_impl(self.pool.k, self.pool.v, k_seq, v_seq, dest)
+            self.pool = PagePool(k, v)
+        else:
+            self.pool = _adopt_quant_impl(self.pool, jnp.asarray(k_seq),
+                                          jnp.asarray(v_seq), dest,
+                                          kv_codec=self.kv_codec)
         self.lengths[slot] = length
 
     def adopt_rows(self, slot: int, k_seq, v_seq,
@@ -837,19 +1060,65 @@ class PagedKVCache:
                              f"length {int(self.lengths[slot])}")
         self.ensure_writable(slot, stop)
         dest = jnp.asarray(self._flat_indices(slot, stop)[start:])
-        k, v = _adopt_impl(self.pool.k, self.pool.v, k_seq, v_seq, dest)
-        self.pool = PagePool(k, v)
+        if self.kv_codec == "fp":
+            k, v = _adopt_impl(self.pool.k, self.pool.v, k_seq, v_seq, dest)
+            self.pool = PagePool(k, v)
+        else:
+            self.pool = _adopt_quant_impl(self.pool, jnp.asarray(k_seq),
+                                          jnp.asarray(v_seq), dest,
+                                          kv_codec=self.kv_codec)
         self.lengths[slot] = stop
+
+    def adopt_packed(self, slot: int, k_codes, v_codes, k_scale, v_scale,
+                     length: int) -> None:
+        """Write already-packed (L, length, KV, hdc) codes + (L, length, KV)
+        scales into ``slot`` — the restore/readmit path for quantized
+        checkpoints. No requantize happens, so the pool bytes equal the
+        gathered bytes exactly, across any pool geometry."""
+        self._require_pool("adopt_packed")
+        if self.kv_codec == "fp":
+            raise ValueError("adopt_packed is for quantized tiers; "
+                             "fp pools adopt fp rows via adopt()")
+        self.ensure(slot, length)
+        self.prepare_write(slot, length, start=0)
+        dest = jnp.asarray(self._flat_indices(slot, length))
+        self.pool = _adopt_packed_impl(
+            self.pool, jnp.asarray(k_codes), jnp.asarray(v_codes),
+            jnp.asarray(k_scale), jnp.asarray(v_scale), dest)
+        self.lengths[slot] = length
 
     def gather_slot(self, slot: int) -> dict:
         """Read ``slot``'s K/V back as the contiguous host state dict the
         recovery checkpoint stores: {"k": (L, length, KV, hd), "v": ...,
-        "length"} — byte-identical to the contiguous cache prefix."""
+        "length"} — byte-identical to the contiguous cache prefix on the fp
+        tier; on quantized tiers the rows come back DEQUANTIZED to fp32
+        (the suffix-prefill compute path — use :meth:`gather_slot_packed`
+        when the bytes themselves must survive)."""
         self._require_pool("gather_slot")
         n = int(self.lengths[slot])
         idx = jnp.asarray(self._flat_indices(slot, max(n, 1)))
-        k, v = _gather_impl(self.pool.k, self.pool.v, idx)
+        if self.kv_codec == "fp":
+            k, v = _gather_impl(self.pool.k, self.pool.v, idx)
+        else:
+            k, v = _gather_quant_impl(self.pool, idx, kv_codec=self.kv_codec)
         return {"k": np.asarray(k)[:, :n], "v": np.asarray(v)[:, :n],
+                "length": np.asarray(n, np.int32)}
+
+    def gather_slot_packed(self, slot: int) -> dict:
+        """Quantized-tier eviction/checkpoint form: {"k_codes", "v_codes",
+        "k_scale", "v_scale", "length"} host arrays — raw pool bytes, so
+        gather -> adopt_packed round-trips bit-exactly by construction."""
+        self._require_pool("gather_slot_packed")
+        if self.kv_codec == "fp":
+            raise ValueError("gather_slot_packed is for quantized tiers; "
+                             "fp pools use gather_slot()")
+        n = int(self.lengths[slot])
+        idx = jnp.asarray(self._flat_indices(slot, max(n, 1)))
+        kc, vc, ks, vs = _gather_packed_impl(self.pool, idx)
+        return {"k_codes": np.asarray(kc)[:, :n],
+                "v_codes": np.asarray(vc)[:, :n],
+                "k_scale": np.asarray(ks)[:, :n],
+                "v_scale": np.asarray(vs)[:, :n],
                 "length": np.asarray(n, np.int32)}
 
     def defrag(self) -> int:
@@ -902,8 +1171,15 @@ class PagedKVCache:
         self._index_holds = self._index_holds[src].copy()
         self._free = list(range(self.num_pages - 1, nxt - 1, -1))
         if moved:
-            k, v = _permute_impl(self.pool.k, self.pool.v, jnp.asarray(src))
-            self.pool = PagePool(k, v)
+            if self.kv_codec == "fp":
+                k, v = _permute_impl(self.pool.k, self.pool.v,
+                                     jnp.asarray(src))
+                self.pool = PagePool(k, v)
+            else:
+                # pages move as bytes: codes and scales ride the same
+                # permutation, nothing requantizes
+                self.pool = QuantPagePool(
+                    *_permute_pool_impl(tuple(self.pool), jnp.asarray(src)))
         return moved
 
     # -- serialization -----------------------------------------------------
@@ -913,13 +1189,23 @@ class PagedKVCache:
         (Per-slot checkpoints use :meth:`gather_slot` instead, which is
         geometry-independent.)"""
         self._require_pool("state_dict")
-        state = {"k": np.asarray(self.pool.k), "v": np.asarray(self.pool.v),
-                 "page_table": self.page_table.copy(),
-                 "lengths": self.lengths.copy(),
-                 "active": self.active.copy(),
-                 "free": np.asarray(self._free, np.int32),
-                 "refcount": self._refcount.copy(),
-                 "index_holds": self._index_holds.copy()}
+        if self.kv_codec == "fp":
+            # pre-quantization key set, unchanged: old checkpoints and fp
+            # pools stay mutually loadable
+            state = {"k": np.asarray(self.pool.k),
+                     "v": np.asarray(self.pool.v)}
+        else:
+            state = {"kv_codec": self.kv_codec,
+                     "k_codes": np.asarray(self.pool.k),
+                     "v_codes": np.asarray(self.pool.v),
+                     "k_scale": np.asarray(self.pool.k_scale),
+                     "v_scale": np.asarray(self.pool.v_scale)}
+        state.update({"page_table": self.page_table.copy(),
+                      "lengths": self.lengths.copy(),
+                      "active": self.active.copy(),
+                      "free": np.asarray(self._free, np.int32),
+                      "refcount": self._refcount.copy(),
+                      "index_holds": self._index_holds.copy()})
         if self.prefix is not None:
             state["prefix_index"] = self.prefix.to_array()
         return state
@@ -931,12 +1217,32 @@ class PagedKVCache:
         exclusive refcounts from the slot tables, so restore never
         double-frees or leaks a page either way."""
         self._require_pool("load_state_dict")
-        if state["k"].shape != self.pool.k.shape:
+        ck = state.get("kv_codec", "fp")
+        if ck != self.kv_codec:
+            # REFUSAL, not transcode: silently requantizing (or inflating)
+            # a whole pool would change every page's bytes under checkpoints
+            # that promise bit-exact round-trips — the caller must build a
+            # cache at the checkpoint's tier instead.
             raise ValueError(
-                f"pool shape mismatch: checkpoint {state['k'].shape} vs "
-                f"cache {self.pool.k.shape}")
-        self.pool = PagePool(jnp.asarray(state["k"]),
-                             jnp.asarray(state["v"]))
+                f"KV tier mismatch: checkpoint stores {ck!r} pages, this "
+                f"cache is {self.kv_codec!r}; rebuild the pool with "
+                f"kv_codec={ck!r} (at-rest transcoding is refused)")
+        if self.kv_codec == "fp":
+            if state["k"].shape != self.pool.k.shape:
+                raise ValueError(
+                    f"pool shape mismatch: checkpoint {state['k'].shape} vs "
+                    f"cache {self.pool.k.shape}")
+            self.pool = PagePool(jnp.asarray(state["k"]),
+                                 jnp.asarray(state["v"]))
+        else:
+            if state["k_codes"].shape != self.pool.k.shape:
+                raise ValueError(
+                    f"pool shape mismatch: checkpoint "
+                    f"{state['k_codes'].shape} vs {self.pool.k.shape}")
+            self.pool = QuantPagePool(jnp.asarray(state["k_codes"]),
+                                      jnp.asarray(state["v_codes"]),
+                                      jnp.asarray(state["k_scale"]),
+                                      jnp.asarray(state["v_scale"]))
         self.page_table = np.asarray(state["page_table"], np.int32).copy()
         self.lengths = np.asarray(state["lengths"], np.int32).copy()
         self.active = np.asarray(state["active"], bool).copy()
@@ -1180,3 +1486,122 @@ def paged_decode_step(cfg: ModelConfig, params: dict,
         body, hidden, (params["layers"], pool_k, pool_v))
     logits = unembed(cfg, params, hidden)[:, -1]  # (B, V) fp32
     return logits, k_new, v_new
+
+
+def _attention_decode_paged_quant(cfg: ModelConfig, lp: dict, x: jnp.ndarray,
+                                  cos_b, sin_b, k_pages, v_pages,
+                                  k_scale, v_scale, page_table, lengths,
+                                  kv_codec: str,
+                                  tp_axis: Optional[str] = None):
+    """Quantized-pool twin of :func:`_attention_decode_paged`: the freshly
+    projected K/V row quantizes ON APPEND (codes + its own per-row scales
+    scatter into the pool — neighbouring rows are untouched, which is why
+    scales are per row and not per page), then the ragged attention
+    dequantizes in-kernel. The current token therefore attends its OWN
+    quantized K/V, consistent with what every later step will read."""
+    b, s1, d = x.shape
+    hd = cfg.head_dim
+    h, kv = lp["wq"].shape[-1] // hd, lp["wk"].shape[-1] // hd
+    q = (x @ lp["wq"]).reshape(b, s1, h, hd)
+    k = (x @ lp["wk"]).reshape(b, s1, kv, hd)
+    v = (x @ lp["wv"]).reshape(b, s1, kv, hd)
+    if "bq" in lp:
+        q = q + lp["bq"].reshape(h, hd)
+        k = k + lp["bk"].reshape(kv, hd)
+        v = v + lp["bv"].reshape(kv, hd)
+    q = _apply_rotary_rows(q, cos_b, sin_b, cfg.rotary_dim)
+    k = _apply_rotary_rows(k, cos_b, sin_b, cfg.rotary_dim)
+
+    from .flash_attention import paged_decode_attention_quant, quantize_kv_rows
+
+    qk, sk = quantize_kv_rows(k[:, 0], kv_codec)  # (B, KV, hdc), (B, KV)
+    qv, sv = quantize_kv_rows(v[:, 0], kv_codec)
+    pn, ps = k_pages.shape[0], k_pages.shape[1]
+    dest = (page_table[jnp.arange(b), lengths // ps] * ps
+            + lengths % ps)  # (B,)
+    ctail = k_pages.shape[2:]
+    k_pages = k_pages.reshape(pn * ps, *ctail).at[dest].set(
+        qk.astype(k_pages.dtype)).reshape(pn, ps, *ctail)
+    v_pages = v_pages.reshape(pn * ps, *ctail).at[dest].set(
+        qv.astype(v_pages.dtype)).reshape(pn, ps, *ctail)
+    k_scale = k_scale.reshape(pn * ps, kv).at[dest].set(
+        sk).reshape(pn, ps, kv)
+    v_scale = v_scale.reshape(pn * ps, kv).at[dest].set(
+        sv).reshape(pn, ps, kv)
+
+    out = paged_decode_attention_quant(q, k_pages, v_pages, k_scale, v_scale,
+                                       page_table, lengths + 1,
+                                       kv_codec=kv_codec)
+    out = out.astype(x.dtype).reshape(b, s1, h * hd) @ lp["wo"]
+    if tp_axis is not None:
+        out = jax.lax.psum(out, tp_axis)
+    if "bo" in lp:
+        out = out + lp["bo"]
+    return out, k_pages, v_pages, k_scale, v_scale
+
+
+def block_decode_paged_quant(cfg: ModelConfig, lp: dict, hidden: jnp.ndarray,
+                             cos_b, sin_b, k_pages, v_pages,
+                             k_scale, v_scale, page_table, lengths,
+                             kv_codec: str,
+                             tp_axis: Optional[str] = None):
+    """One layer of the quantized paged decode: same norm/residual/MLP
+    structure as :func:`block_decode_paged`, quantized attention core."""
+    if cfg.family == "gpt_neox":
+        attn_in = _layernorm(hidden, lp["ln1_scale"], lp["ln1_bias"],
+                             cfg.norm_eps)
+        attn_out, k_pages, v_pages, k_scale, v_scale = (
+            _attention_decode_paged_quant(
+                cfg, lp, attn_in, cos_b, sin_b, k_pages, v_pages,
+                k_scale, v_scale, page_table, lengths, kv_codec, tp_axis))
+        mlp_in = _layernorm(hidden, lp["ln2_scale"], lp["ln2_bias"],
+                            cfg.norm_eps)
+        return (hidden + attn_out + mlp(cfg, lp, mlp_in, tp_axis),
+                k_pages, v_pages, k_scale, v_scale)
+    attn_in = _rmsnorm(hidden, lp["ln1_scale"], cfg.norm_eps)
+    attn_out, k_pages, v_pages, k_scale, v_scale = (
+        _attention_decode_paged_quant(
+            cfg, lp, attn_in, cos_b, sin_b, k_pages, v_pages,
+            k_scale, v_scale, page_table, lengths, kv_codec, tp_axis))
+    hidden = hidden + attn_out
+    mlp_in = _rmsnorm(hidden, lp["ln2_scale"], cfg.norm_eps)
+    return (hidden + mlp(cfg, lp, mlp_in, tp_axis),
+            k_pages, v_pages, k_scale, v_scale)
+
+
+@graph_contract("paged.decode_step_quant", collectives={},
+                donate=lambda ctx: ctx.get("donate_min", 4))
+def paged_decode_step_quant(cfg: ModelConfig, params: dict,
+                            pool_k: jnp.ndarray, pool_v: jnp.ndarray,
+                            pool_k_scale: jnp.ndarray,
+                            pool_v_scale: jnp.ndarray,
+                            page_table: jnp.ndarray, lengths: jnp.ndarray,
+                            token_ids: jnp.ndarray, *, kv_codec: str,
+                            compute_dtype: Optional[jnp.dtype] = None):
+    """Quantized-pool twin of :func:`paged_decode_step`: a SEPARATE
+    entrypoint, not a branch — the fp tier keeps tracing the exact
+    pre-quantization graph (the disabled-build identity the lint layer
+    pins), and this one carries the four QuantPagePool arrays through the
+    layer scan. Returns (logits (max_slots, V) fp32, pool_k, pool_v,
+    pool_k_scale, pool_v_scale)."""
+    params = _cast_params(params, compute_dtype)
+    if token_ids.ndim == 1:
+        token_ids = token_ids[:, None]
+    hidden = embed(params, token_ids)  # (B, 1, D)
+    span = page_table.shape[1] * pool_k.shape[2]  # pages_per_slot * page_size
+    cos, sin = precompute_rope(cfg, span)
+    cos_b = cos[lengths]  # (B, rot) — each slot's own row
+    sin_b = sin[lengths]
+
+    def body(h, xs):
+        lp, kp, vp, ks, vs = xs
+        h, kp, vp, ks, vs = block_decode_paged_quant(
+            cfg, lp, h, cos_b, sin_b, kp, vp, ks, vs, page_table, lengths,
+            kv_codec)
+        return h, (kp, vp, ks, vs)
+
+    hidden, (k_new, v_new, ks_new, vs_new) = jax.lax.scan(
+        body, hidden, (params["layers"], pool_k, pool_v,
+                       pool_k_scale, pool_v_scale))
+    logits = unembed(cfg, params, hidden)[:, -1]  # (B, V) fp32
+    return logits, k_new, v_new, ks_new, vs_new

@@ -37,7 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.configs import ModelConfig
 from ..models.transformer import (block, block_decode, block_verify, embed,
                                   unembed, precompute_rope, KVCache)
-from ..models.paged_kv import block_decode_paged
+from ..models.paged_kv import block_decode_paged, block_decode_paged_quant, \
+    resolve_kv_codec
 from ..codecs.packing import get_wire_codec, WireCodec
 from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
 from ..codecs.pallas_kernels import fused_hop, fused_hop_plan
@@ -81,6 +82,67 @@ def _gather_paged_impl(pool_k, pool_v, idx):
     flat_k = pool_k.reshape(ns, sz, pn * ps, *tail)
     flat_v = pool_v.reshape(ns, sz, pn * ps, *tail)
     return flat_k[:, :, idx], flat_v[:, :, idx]
+
+
+# Quantized-pool twins (KV-at-rest tiers, models.paged_kv): the per-stage
+# pool becomes FOUR arrays — packed K/V codes plus per-row fp32 scales —
+# and page surgery moves them together as bytes. Only adopt (fp rows in,
+# quantize on append) and gather (dequantize out) touch the codec; the
+# *_packed pair is the lossless checkpoint/eviction form.
+
+
+def _paged_rows_set(arr, dest, rows):
+    ns, sz, pn, ps = arr.shape[:4]
+    tail = arr.shape[4:]
+    return (arr.reshape(ns, sz, pn * ps, *tail).at[:, :, dest]
+            .set(rows.astype(arr.dtype)).reshape(arr.shape))
+
+
+def _paged_rows_get(arr, idx):
+    ns, sz, pn, ps = arr.shape[:4]
+    tail = arr.shape[4:]
+    return arr.reshape(ns, sz, pn * ps, *tail)[:, :, idx]
+
+
+@functools.partial(jax.jit, static_argnames=("kv_codec",),
+                   donate_argnums=(0,))
+def _adopt_paged_quant_impl(arrays, k_seq, v_seq, dest, kv_codec: str):
+    from ..models.flash_attention import quantize_kv_rows
+
+    pk, pv, ks, vs = arrays
+    qk, sk = quantize_kv_rows(k_seq, kv_codec)
+    qv, sv = quantize_kv_rows(v_seq, kv_codec)
+    return (_paged_rows_set(pk, dest, qk), _paged_rows_set(pv, dest, qv),
+            _paged_rows_set(ks, dest, sk), _paged_rows_set(vs, dest, sv))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _adopt_paged_packed_impl(arrays, k_codes, v_codes, k_scale, v_scale,
+                             dest):
+    pk, pv, ks, vs = arrays
+    return (_paged_rows_set(pk, dest, k_codes),
+            _paged_rows_set(pv, dest, v_codes),
+            _paged_rows_set(ks, dest, k_scale),
+            _paged_rows_set(vs, dest, v_scale))
+
+
+@jax.jit
+def _gather_paged_packed_impl(arrays, idx):
+    return tuple(_paged_rows_get(a, idx) for a in arrays)
+
+
+@functools.partial(jax.jit, static_argnames=("kv_codec",))
+def _gather_paged_quant_impl(arrays, idx, kv_codec: str):
+    from ..models.flash_attention import dequantize_kv_rows
+
+    kc, vc, ks, vs = _gather_paged_packed_impl(arrays, idx)
+    return (dequantize_kv_rows(kc, ks, kv_codec),
+            dequantize_kv_rows(vc, vs, kv_codec))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _copy_paged_pool_impl(arrays, src, dst):
+    return tuple(a.at[:, :, dst].set(a[:, :, src]) for a in arrays)
 
 
 def make_stage_mesh(n_stages: int, n_data: int = 1, n_model: int = 1,
@@ -1481,31 +1543,69 @@ class SplitRuntime:
     # cross a cut.
 
     def init_paged_pool(self, num_pages: int, page_size: int,
-                        dtype=jnp.float32) -> dict:
+                        dtype=jnp.float32, kv_codec: str = "fp") -> dict:
         """Zeroed per-stage paged KV pools, placed sharded on "stage".
         Page 0 is the trash page (see models.paged_kv) — host-side page
-        tables must never hand it out."""
+        tables must never hand it out. Quantized ``kv_codec`` tiers return
+        FOUR arrays — packed codes {"k", "v"} plus per-row fp32 scales
+        {"k_scale", "v_scale"} — and a "kv_codec" tag the paged methods
+        dispatch on; the fp pool dict is unchanged."""
         self._check_decode_supported()
         if num_pages < 2:
             raise ValueError("need num_pages >= 2 (page 0 is the trash page)")
         cfg = self.cfg
-        shape = (self.split.n_stages, self.stage_size, num_pages, page_size,
-                 cfg.num_kv_heads, cfg.head_dim)
+        codec = resolve_kv_codec(kv_codec)
         sh = NamedSharding(self.mesh, P("stage"))
-        zeros = functools.partial(jax.jit, static_argnums=0,
-                                  out_shardings=sh)(
-            lambda s: jnp.zeros(s, dtype))
-        return {"k": zeros(shape), "v": zeros(shape)}
+        if not codec.quantized:
+            shape = (self.split.n_stages, self.stage_size, num_pages,
+                     page_size, cfg.num_kv_heads, cfg.head_dim)
+            zeros = functools.partial(jax.jit, static_argnums=0,
+                                      out_shardings=sh)(
+                lambda s: jnp.zeros(s, dtype))
+            return {"k": zeros(shape), "v": zeros(shape)}
+        hdc = codec.code_lanes(cfg.head_dim)
+        cshape = (self.split.n_stages, self.stage_size, num_pages, page_size,
+                  cfg.num_kv_heads, hdc)
+        sshape = cshape[:-1]
+        czeros = functools.partial(jax.jit, static_argnums=0,
+                                   out_shardings=sh)(
+            lambda s: jnp.zeros(s, codec.code_dtype))
+        szeros = functools.partial(jax.jit, static_argnums=0,
+                                   out_shardings=sh)(
+            lambda s: jnp.zeros(s, jnp.float32))
+        return {"k": czeros(cshape), "v": czeros(cshape),
+                "k_scale": szeros(sshape), "v_scale": szeros(sshape),
+                "kv_codec": codec.name}
+
+    @staticmethod
+    def _pool_codec(pool: dict) -> str:
+        return pool.get("kv_codec", "fp") if "k_scale" in pool else "fp"
+
+    @staticmethod
+    def _pool_arrays(pool: dict) -> tuple:
+        return (pool["k"], pool["v"], pool["k_scale"], pool["v_scale"])
+
+    @staticmethod
+    def _pool_dict(arrays: tuple, kv_codec: str) -> dict:
+        pk, pv, ks, vs = arrays
+        return {"k": pk, "v": pv, "k_scale": ks, "v_scale": vs,
+                "kv_codec": kv_codec}
 
     def adopt_paged(self, pool: dict, cache: dict, row: int,
                     dest: np.ndarray, length: int) -> dict:
         """Move one stream's prefilled contiguous cache (``prefill_decode``
         row ``row``) into pool pages at flat token indices ``dest``
         ((length,) int32, from PagedKVCache._flat_indices). Donates the pool
-        buffers — the scatter is stage-elementwise, no collectives."""
+        buffers — the scatter is stage-elementwise, no collectives. On a
+        quantized pool the fp rows quantize on append."""
         dest = jnp.asarray(dest, jnp.int32)
         k_seq = cache["k"][:, :, row, :length]   # (n_stages, sz, n, KV, hd)
         v_seq = cache["v"][:, :, row, :length]
+        codec = self._pool_codec(pool)
+        if codec != "fp":
+            return self._pool_dict(_adopt_paged_quant_impl(
+                self._pool_arrays(pool), k_seq, v_seq, dest,
+                kv_codec=codec), codec)
         pk, pv = _adopt_paged_impl(pool["k"], pool["v"], k_seq, v_seq, dest)
         return {"k": pk, "v": pv}
 
@@ -1514,20 +1614,44 @@ class SplitRuntime:
         """Scatter an already-contiguous (n_stages, sz, n, KV, hd) K/V prefix
         — a :meth:`gather_paged` payload, possibly round-tripped through a
         checkpoint — into pool pages at flat token indices ``dest``. The
-        re-admission half of eviction for the split batcher."""
+        re-admission half of eviction for the split batcher. Quantized pools
+        requantize fp rows here; bit-exact resume uses the packed twin."""
         dest = jnp.asarray(dest, jnp.int32)
+        codec = self._pool_codec(pool)
+        if codec != "fp":
+            return self._pool_dict(_adopt_paged_quant_impl(
+                self._pool_arrays(pool), jnp.asarray(k_seq),
+                jnp.asarray(v_seq), dest, kv_codec=codec), codec)
         pk, pv = _adopt_paged_impl(pool["k"], pool["v"], jnp.asarray(k_seq),
                                    jnp.asarray(v_seq), dest)
         return {"k": pk, "v": pv}
+
+    def adopt_paged_rows_packed(self, pool: dict, k_codes, v_codes,
+                                k_scale, v_scale, dest: np.ndarray) -> dict:
+        """Scatter a :meth:`gather_paged_packed` payload back — raw codes +
+        scales, no requantize, so evict -> readmit is bit-exact."""
+        codec = self._pool_codec(pool)
+        if codec == "fp":
+            raise ValueError("adopt_paged_rows_packed needs a quantized "
+                             "pool; fp pools adopt fp rows")
+        return self._pool_dict(_adopt_paged_packed_impl(
+            self._pool_arrays(pool), jnp.asarray(k_codes),
+            jnp.asarray(v_codes), jnp.asarray(k_scale),
+            jnp.asarray(v_scale), jnp.asarray(dest, jnp.int32)), codec)
 
     def copy_paged_pages(self, pool: dict, src, dst) -> dict:
         """Apply prefix-cache COW forks to the per-stage pools: duplicate
         pages ``src`` to ``dst`` (parallel 1-D index lists from
         ``PagedKVCache.ensure_writable``'s (old, new) pairs). Donates the
-        pool buffers; stage-elementwise, no collectives."""
-        pk, pv = _copy_paged_impl(pool["k"], pool["v"],
-                                  jnp.asarray(src, jnp.int32),
-                                  jnp.asarray(dst, jnp.int32))
+        pool buffers; stage-elementwise, no collectives. Quantized pools
+        copy codes AND scales — a fork is a byte move, never a requantize."""
+        src = jnp.asarray(src, jnp.int32)
+        dst = jnp.asarray(dst, jnp.int32)
+        codec = self._pool_codec(pool)
+        if codec != "fp":
+            return self._pool_dict(_copy_paged_pool_impl(
+                self._pool_arrays(pool), src, dst), codec)
+        pk, pv = _copy_paged_impl(pool["k"], pool["v"], src, dst)
         return {"k": pk, "v": pv}
 
     def gather_paged(self, pool: dict, idx: np.ndarray) -> tuple:
@@ -1535,17 +1659,41 @@ class SplitRuntime:
         pages at flat token indices ``idx`` — byte-identical to the
         contiguous cache rows :meth:`adopt_paged` scattered (the split twin
         of ``PagedKVCache.gather_slot``, for eviction and checkpointing).
-        Returns host (k_seq, v_seq) numpy arrays; the pool is NOT consumed."""
+        Returns host (k_seq, v_seq) numpy arrays; the pool is NOT consumed.
+        Quantized pools come back DEQUANTIZED to fp32 (the suffix-prefill
+        compute form); the packed twin preserves the raw bytes."""
         idx = jnp.asarray(idx, jnp.int32)
+        codec = self._pool_codec(pool)
+        if codec != "fp":
+            k_seq, v_seq = _gather_paged_quant_impl(
+                self._pool_arrays(pool), idx, kv_codec=codec)
+            return np.asarray(k_seq), np.asarray(v_seq)
         k_seq, v_seq = _gather_paged_impl(pool["k"], pool["v"], idx)
         return np.asarray(k_seq), np.asarray(v_seq)
 
-    def _paged_decode_fns(self, num_pages: int, page_size: int):
+    def gather_paged_packed(self, pool: dict, idx: np.ndarray) -> tuple:
+        """Quantized-pool eviction/checkpoint form: host (k_codes, v_codes,
+        k_scale, v_scale) numpy arrays at flat token indices ``idx`` — the
+        raw pool bytes, so the adopt_paged_rows_packed round-trip is
+        bit-exact by construction."""
+        if self._pool_codec(pool) == "fp":
+            raise ValueError("gather_paged_packed needs a quantized pool; "
+                             "fp pools use gather_paged")
+        out = _gather_paged_packed_impl(self._pool_arrays(pool),
+                                        jnp.asarray(idx, jnp.int32))
+        return tuple(np.asarray(a) for a in out)
+
+    def _paged_decode_fns(self, num_pages: int, page_size: int,
+                          kv_codec: str = "fp"):
         """Build (or fetch) the jitted ragged step executable for one pool
         geometry. Page table and lengths are TRACED — one executable per
         (num_pages, page_size, max_slots, pages_per_slot) shape serves every
         admit/evict/fill state (the jit-miss-free property batching relies
-        on)."""
+        on). Quantized ``kv_codec`` tiers get their own executable carrying
+        four pool arrays (codes + scales) through every hop."""
+        if kv_codec != "fp":
+            return self._paged_decode_fns_quant(num_pages, page_size,
+                                                kv_codec)
         key = ("paged", num_pages, page_size)
         if key in self._paged_fns_cache:
             return self._paged_fns_cache[key]
@@ -1679,6 +1827,107 @@ class SplitRuntime:
         self._paged_fns_cache[key] = step_paged_fn
         return step_paged_fn
 
+    def _paged_decode_fns_quant(self, num_pages: int, page_size: int,
+                                kv_codec: str):
+        """Quantized twin of :meth:`_paged_decode_fns`: the scan carries
+        packed codes AND per-row scales, every layer dequantizes in-kernel
+        (models.flash_attention.paged_decode_attention_quant), and appends
+        quantize before the scatter. Unpipelined only — the µ-batch trash
+        -page routing has no quant twin (ContinuousBatcher refuses the
+        combination up front)."""
+        key = ("paged_quant", num_pages, page_size, kv_codec)
+        if key in self._paged_fns_cache:
+            return self._paged_fns_cache[key]
+        if self.pipelined and self.pipeline.num_microbatches > 1:
+            raise ValueError(
+                "quantized paged decode composes with the unpipelined split "
+                "runtime only (n_micro must be 1)")
+        cfg, n_stages, sz = self.cfg, self.split.n_stages, self.stage_size
+        codecs, mesh = self.codecs, self.mesh
+        layer_pspec = self._layer_pspec
+        link = self._link
+        fused_plans = self.fused_plans
+
+        def _hop_protocol(run_stage, hidden, carry, fault_key):
+            if link is None:
+                out, c = run_pipeline_stages_carry(
+                    n_stages, codecs, run_stage, hidden, carry,
+                    fused_plans=fused_plans)
+                return out, c, None
+            return run_pipeline_stages_carry(
+                n_stages, codecs, run_stage, hidden, carry,
+                link=link, fault_key=fault_key)
+
+        def stage_step_paged_quant(local_layers, local_valid, hidden, kp_loc,
+                                   vp_loc, ks_loc, vs_loc, page_table,
+                                   lengths, cos_b, sin_b):
+            lv = {k: v[0] for k, v in local_layers.items()}
+            valid = local_valid[0]
+            hidden = pcast_varying(hidden, ("stage",))
+            fkey = None if link is None else jax.random.fold_in(
+                jax.random.fold_in(jax.random.key(link.faults.seed), 0x57E9),
+                jnp.max(lengths))
+
+            def scan_body(h, xs):
+                lp, ok, kp, vp, ks, vs = xs
+                out, kp2, vp2, ks2, vs2 = block_decode_paged_quant(
+                    cfg, lp, h, cos_b, sin_b, kp, vp, ks, vs, page_table,
+                    lengths, kv_codec)
+                # padding layers are identity AND must not touch their pages
+                return jnp.where(ok, out, h), (
+                    jnp.where(ok, kp2, kp), jnp.where(ok, vp2, vp),
+                    jnp.where(ok, ks2, ks), jnp.where(ok, vs2, vs))
+
+            def run_stage(h, cache):
+                kp, vp, ks, vs = cache
+                h2, cache2 = jax.lax.scan(scan_body, h,
+                                          (lv, valid, kp, vp, ks, vs))
+                return h2, cache2
+
+            out, (kp, vp, ks, vs), counters = _hop_protocol(
+                run_stage, hidden,
+                (kp_loc[0], vp_loc[0], ks_loc[0], vs_loc[0]), fkey)
+            if link is None:
+                return out, kp[None], vp[None], ks[None], vs[None]
+            return out, kp[None], vp[None], ks[None], vs[None], counters
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2, 3, 4))
+        def step_paged_quant_fn(placed, pool_k, pool_v, pool_ks, pool_vs,
+                                page_table, lengths, token_ids):
+            hidden = embed(placed, token_ids[:, None])  # (B, 1, D)
+            span = page_table.shape[1] * page_size
+            cos, sin = precompute_rope(cfg, span)
+            cos_b = cos[lengths]
+            sin_b = sin[lengths]
+            lspecs = {k: layer_pspec(k, v.ndim)
+                      for k, v in placed["layers"].items()}
+            if link is None:
+                out, kp, vp, ks, vs = shard_map(
+                    stage_step_paged_quant, mesh=mesh,
+                    in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                              P("stage"), P("stage"), P(), P(), P(), P()),
+                    out_specs=(P(), P("stage"), P("stage"), P("stage"),
+                               P("stage")),
+                    check_vma=False,
+                )(placed["layers"], placed["layers_valid"], hidden,
+                  pool_k, pool_v, pool_ks, pool_vs, page_table, lengths,
+                  cos_b, sin_b)
+                return unembed(cfg, placed, out)[:, -1], kp, vp, ks, vs
+            out, kp, vp, ks, vs, counters = shard_map(
+                stage_step_paged_quant, mesh=mesh,
+                in_specs=(lspecs, P("stage"), P(), P("stage"), P("stage"),
+                          P("stage"), P("stage"), P(), P(), P(), P()),
+                out_specs=(P(), P("stage"), P("stage"), P("stage"),
+                           P("stage"), P()),
+                check_vma=False,
+            )(placed["layers"], placed["layers_valid"], hidden,
+              pool_k, pool_v, pool_ks, pool_vs, page_table, lengths,
+              cos_b, sin_b)
+            return unembed(cfg, placed, out)[:, -1], kp, vp, ks, vs, counters
+
+        self._paged_fns_cache[key] = step_paged_quant_fn
+        return step_paged_quant_fn
+
     @graph_contract(
         "split.decode_step_paged",
         collectives=lambda ctx: {"ppermute": ctx["hop_eqns"], "psum": 1},
@@ -1710,9 +1959,22 @@ class SplitRuntime:
             self.pipeline.validate_batch(int(np.shape(page_table)[0]),
                                          "paged decode slot count")
         num_pages, page_size = pool["k"].shape[2], pool["k"].shape[3]
-        step_fn = self._paged_decode_fns(int(num_pages), int(page_size))
+        codec = self._pool_codec(pool)
+        step_fn = self._paged_decode_fns(int(num_pages), int(page_size),
+                                         kv_codec=codec)
         page_table = jnp.asarray(page_table, jnp.int32)
         lengths = jnp.asarray(lengths, jnp.int32)
+        if codec != "fp":
+            if self._link is None:
+                logits, pk, pv, ks, vs = step_fn(
+                    placed_params, pool["k"], pool["v"], pool["k_scale"],
+                    pool["v_scale"], page_table, lengths, token_ids)
+            else:
+                logits, pk, pv, ks, vs, counters = step_fn(
+                    placed_params, pool["k"], pool["v"], pool["k_scale"],
+                    pool["v_scale"], page_table, lengths, token_ids)
+                self._accum_counters(counters)
+            return logits, self._pool_dict((pk, pv, ks, vs), codec)
         if self._link is None:
             logits, pk, pv = step_fn(placed_params, pool["k"], pool["v"],
                                      page_table, lengths, token_ids)

@@ -117,6 +117,7 @@ _PARAM_KEYS = {
     "serving": "serve",
     "batching": "serve",
     "prefix_cache": "serve",
+    "kv_at_rest": "serve",
     "speculative": "serve",
     "max_compiles": "distances",
     "observability": "all",
@@ -369,9 +370,9 @@ def _validate_params_json(p: dict) -> None:
                 f"got {b!r}")
         # dtype fields are runtime objects, not JSON — keep them out of the
         # schema so a typo'd key dies with the real field list; prefix_cache
-        # has its own top-level params block
+        # and kv_codec have their own top-level params blocks
         fields = {f.name for f in dataclasses.fields(BatchingConfig)} \
-            - {"compute_dtype", "cache_dtype", "prefix_cache"}
+            - {"compute_dtype", "cache_dtype", "prefix_cache", "kv_codec"}
         bad = sorted(set(b) - fields)
         if bad:
             die(f"batching: unknown field(s) {bad}; known: {sorted(fields)}")
@@ -413,6 +414,34 @@ def _validate_params_json(p: dict) -> None:
             PrefixCacheConfig(**pc)
         except (TypeError, ValueError) as e:
             die(f"prefix_cache: {e}")
+    if "kv_at_rest" in p:
+        from .models.paged_kv import KV_PAGE_CODECS, resolve_kv_codec
+
+        if exp != "serve":
+            die("kv_at_rest only applies to experiment 'serve'")
+        if "batching" not in p:
+            die("kv_at_rest compresses the continuous batcher's paged pool "
+                "— add a 'batching' block")
+        kq = p["kv_at_rest"]
+        if not isinstance(kq, dict):
+            die(f"kv_at_rest must be an object with a 'codec' tier (and "
+                f"optional 'pool_bytes'), got {kq!r}")
+        bad = sorted(set(kq) - {"codec", "pool_bytes"})
+        if bad:
+            die(f"kv_at_rest: unknown field(s) {bad}; "
+                f"known: ['codec', 'pool_bytes']")
+        if "codec" not in kq:
+            die(f"kv_at_rest needs a 'codec' tier name; "
+                f"options: {sorted(KV_PAGE_CODECS)}")
+        try:
+            resolve_kv_codec(kq["codec"])
+        except (TypeError, ValueError) as e:
+            die(f"kv_at_rest: {e}")
+        if "pool_bytes" in kq and (not isinstance(kq["pool_bytes"], int)
+                                   or isinstance(kq["pool_bytes"], bool)
+                                   or kq["pool_bytes"] < 1):
+            die(f"kv_at_rest.pool_bytes must be a positive integer, "
+                f"got {kq['pool_bytes']!r}")
     if "pipeline" in p:
         from .parallel.split import PipelineConfig
 
@@ -950,7 +979,22 @@ def main(argv=None) -> int:
 
                     prefix_kw = dict(prefix_cache=PrefixCacheConfig(
                         **params_json["prefix_cache"]))
-                bcfg = BatchingConfig(**params_json["batching"], **prefix_kw)
+                batching_json = dict(params_json["batching"])
+                if "kv_at_rest" in params_json:
+                    # the at-rest tier rides the batcher pool; with
+                    # "pool_bytes" the page count is re-derived from the
+                    # byte budget — quantized rows are smaller, so the same
+                    # HBM holds more pages (the capacity multiplier)
+                    from .models.paged_kv import num_pages_for_bytes
+
+                    kq = params_json["kv_at_rest"]
+                    prefix_kw["kv_codec"] = kq["codec"]
+                    if "pool_bytes" in kq:
+                        batching_json["num_pages"] = num_pages_for_bytes(
+                            cfg, kq["pool_bytes"],
+                            batching_json.get("page_size", 16),
+                            kv_codec=kq["codec"])
+                bcfg = BatchingConfig(**batching_json, **prefix_kw)
                 split_kw = {}
                 if rt is not None:
                     split_kw = dict(split_runtime=rt,

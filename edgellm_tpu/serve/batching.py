@@ -69,7 +69,8 @@ import jax.numpy as jnp
 
 from ..models.configs import ModelConfig
 from ..models.paged_kv import OutOfPages, OutOfSlots, PagedKVCache, \
-    PrefixCacheConfig, paged_decode_step
+    PrefixCacheConfig, QuantPagePool, paged_decode_step, \
+    paged_decode_step_quant, resolve_kv_codec
 from ..models.transformer import KVCache
 from ..obs import context as obs_context
 from ..obs.flight import flight_dump_for
@@ -103,6 +104,13 @@ class BatchingConfig:
     # copy-on-write pages (models.paged_kv); None = pre-sharing behavior,
     # bit-for-bit (the batching.prefix-disabled-identity graphlint contract)
     prefix_cache: Optional[PrefixCacheConfig] = None
+    # KV-at-rest tier (models.paged_kv.KV_PAGE_CODECS): "fp" stores plain
+    # cache_dtype pages and traces the exact pre-quantization step (the
+    # batching.kvq-disabled-identity graphlint contract); quantized tiers
+    # store packed codes + per-row scales, shrinking bytes-per-token so the
+    # same HBM budget admits 2-4x the concurrency (use num_pages_for_bytes
+    # to size the pool at fixed bytes)
+    kv_codec: str = "fp"
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -123,6 +131,7 @@ class BatchingConfig:
             raise ValueError(
                 f"prefix_cache must be a PrefixCacheConfig or None, got "
                 f"{type(self.prefix_cache).__name__}")
+        resolve_kv_codec(self.kv_codec)  # refuse unknown tier names early
 
     @property
     def span(self) -> int:
@@ -183,10 +192,33 @@ def _batched_step_jit(cfg: ModelConfig, params: dict, pool_k, pool_v,
     return _batched_sample(logits, keys, steps, temps), pool_k, pool_v
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "kv_codec", "compute_dtype"),
+                   donate_argnums=(2, 3, 4, 5))
+def _batched_step_quant_jit(cfg: ModelConfig, params: dict, pool_k, pool_v,
+                            pool_k_scale, pool_v_scale, page_table, lengths,
+                            token_ids, keys, steps, temps, kv_codec,
+                            compute_dtype):
+    """Quantized-tier twin of :func:`_batched_step_jit`: the four
+    QuantPagePool arrays are donated, sampling is the same vmapped
+    ``_batched_sample``. A SEPARATE jit — the fp tier keeps hitting the
+    executable above, whose jaxpr the kvq-disabled-identity contract pins."""
+    logits, pool_k, pool_v, pool_k_scale, pool_v_scale = (
+        paged_decode_step_quant(
+            cfg, params, pool_k, pool_v, pool_k_scale, pool_v_scale,
+            page_table, lengths, token_ids, kv_codec=kv_codec,
+            compute_dtype=compute_dtype))
+    return (_batched_sample(logits, keys, steps, temps),
+            pool_k, pool_v, pool_k_scale, pool_v_scale)
+
+
 def batched_step_cache_size() -> int:
     """Executables compiled for the ragged step so far in this process — the
-    jit-miss counter :meth:`ContinuousBatcher.step` reports deltas of."""
-    return _batched_step_jit._cache_size()
+    jit-miss counter :meth:`ContinuousBatcher.step` reports deltas of.
+    Counts BOTH tier executables: a steady-state serve loop must stop
+    missing on whichever one its pool uses."""
+    return (_batched_step_jit._cache_size()
+            + _batched_step_quant_jit._cache_size())
 
 
 # the split step returns (max_slots, V) logits from decode_step_paged; the
@@ -232,6 +264,11 @@ class ContinuousBatcher:
                         f"of num_microbatches={m}: every ragged decode step "
                         f"feeds the full slot set through the pipelined "
                         f"schedule, which splits it into {m} equal µ-batches")
+                if self.bcfg.kv_codec != "fp":
+                    raise ValueError(
+                        f"kv_codec={self.bcfg.kv_codec!r} composes with the "
+                        f"unpipelined split runtime only; the pipelined "
+                        f"µ-batch schedule has no quantized paged step yet")
         self.placed = placed_params
         # split mode: the host PagedKVCache is the ALLOCATOR only (page
         # table, lengths, free list); the actual K/V pages live per-stage on
@@ -242,11 +279,13 @@ class ContinuousBatcher:
             pages_per_slot=self.bcfg.pages_per_slot,
             dtype=self.bcfg.cache_dtype,
             materialize=split_runtime is None,
-            prefix_cache=self.bcfg.prefix_cache)
+            prefix_cache=self.bcfg.prefix_cache,
+            kv_codec=self.bcfg.kv_codec)
         self._split_pool = (
             split_runtime.init_paged_pool(self.bcfg.num_pages,
                                           self.bcfg.page_size,
-                                          dtype=self.bcfg.cache_dtype)
+                                          dtype=self.bcfg.cache_dtype,
+                                          kv_codec=self.bcfg.kv_codec)
             if split_runtime is not None else None)
         self._streams: dict[int, Stream] = {}
         self._waiting: deque[int] = deque()
@@ -399,13 +438,27 @@ class ContinuousBatcher:
         if st.resume is not None:
             need_len = int(st.resume["length"])
             # resumes adopt privately: the payload mixes prompt and
-            # generated rows, so re-sharing would index decode output
+            # generated rows, so re-sharing would index decode output.
+            # Quantized tiers carry PACKED codes + scales (never fp rows),
+            # so evict -> readmit round-trips the pool bytes exactly.
+            packed = "k_codes" in st.resume
             if self.rt is not None:
                 self.pool.ensure(slot, need_len)
                 dest = self.pool._flat_indices(slot, need_len)
-                self._split_pool = self.rt.adopt_paged_rows(
-                    self._split_pool, st.resume["k"], st.resume["v"], dest)
+                if packed:
+                    self._split_pool = self.rt.adopt_paged_rows_packed(
+                        self._split_pool, st.resume["k_codes"],
+                        st.resume["v_codes"], st.resume["k_scale"],
+                        st.resume["v_scale"], dest)
+                else:
+                    self._split_pool = self.rt.adopt_paged_rows(
+                        self._split_pool, st.resume["k"], st.resume["v"],
+                        dest)
                 self.pool.lengths[slot] = need_len
+            elif packed:
+                self.pool.adopt_packed(
+                    slot, st.resume["k_codes"], st.resume["v_codes"],
+                    st.resume["k_scale"], st.resume["v_scale"], need_len)
             else:
                 self.pool.adopt(slot, jnp.asarray(st.resume["k"]),
                                 jnp.asarray(st.resume["v"]), need_len)
@@ -520,11 +573,21 @@ class ContinuousBatcher:
         Local pool: ``gather_slot``'s (L, n, KV, hd) dict. Split: the
         per-stage (n_stages, sz, n, KV, hd) twin from ``gather_paged`` —
         byte-identical to the rows ``adopt_paged`` scattered, so re-admission
-        through ``adopt_paged_rows`` resumes token-identically."""
+        through ``adopt_paged_rows`` resumes token-identically. Quantized
+        tiers gather the PACKED form (codes + scales, raw pool bytes) so the
+        round-trip is bit-exact with no requantize."""
+        quant = self.bcfg.kv_codec != "fp"
         if self.rt is None:
-            return self.pool.gather_slot(slot)
+            return (self.pool.gather_slot_packed(slot) if quant
+                    else self.pool.gather_slot(slot))
         n = int(self.pool.lengths[slot])
         idx = self.pool._flat_indices(slot, max(n, 1))
+        if quant:
+            kc, vc, ks, vs = self.rt.gather_paged_packed(
+                self._split_pool, idx)
+            return {"k_codes": kc[:, :, :n], "v_codes": vc[:, :, :n],
+                    "k_scale": ks[:, :, :n], "v_scale": vs[:, :, :n],
+                    "length": np.asarray(n, np.int32)}
         k_seq, v_seq = self.rt.gather_paged(self._split_pool, idx)
         return {"k": k_seq[:, :, :n], "v": v_seq[:, :, :n],
                 "length": np.asarray(n, np.int32)}
@@ -595,7 +658,8 @@ class ContinuousBatcher:
         the standalone sampler. Deltas across a step are the jit misses."""
         if self.rt is not None:
             step_fn = self.rt._paged_decode_fns(self.bcfg.num_pages,
-                                                self.bcfg.page_size)
+                                                self.bcfg.page_size,
+                                                kv_codec=self.bcfg.kv_codec)
             return step_fn._cache_size() + _split_sample_jit._cache_size()
         return batched_step_cache_size()
 
@@ -665,6 +729,14 @@ class ContinuousBatcher:
                 jnp.asarray(token_ids))
             toks = _split_sample_jit(logits, jnp.stack(keys),
                                      jnp.asarray(steps), jnp.asarray(temps))
+        elif self.bcfg.kv_codec != "fp":
+            toks, k, v, ks, vs = _batched_step_quant_jit(
+                self.cfg, self.params, self.pool.pool.k, self.pool.pool.v,
+                self.pool.pool.k_scale, self.pool.pool.v_scale,
+                page_table, lengths, jnp.asarray(token_ids),
+                jnp.stack(keys), jnp.asarray(steps), jnp.asarray(temps),
+                self.bcfg.kv_codec, self.bcfg.compute_dtype)
+            self.pool.pool = QuantPagePool(k, v, ks, vs)
         else:
             toks, k, v = _batched_step_jit(
                 self.cfg, self.params, self.pool.pool.k, self.pool.pool.v,
@@ -746,15 +818,28 @@ class ContinuousBatcher:
         else:
             raise CheckpointError(
                 f"stream {sid} ({st.status}) has no cache state to snapshot")
-        arrays = {"cache/k": state["k"], "cache/v": state["v"],
-                  "cache/length": state["length"],
-                  "prompt_ids": st.prompt[None, :].astype(np.int32),
-                  "tokens": np.asarray(st.tokens, np.int32)[None, :]}
+        if "k_codes" in state:
+            # quantized tier: the CRC-framed payload is the PACKED layout
+            # (codes + per-row scales) — restore scatters the same bytes
+            # back, so the round-trip is bit-exact across pool geometries
+            arrays = {"cache/k_codes": state["k_codes"],
+                      "cache/v_codes": state["v_codes"],
+                      "cache/k_scale": state["k_scale"],
+                      "cache/v_scale": state["v_scale"]}
+        else:
+            arrays = {"cache/k": state["k"], "cache/v": state["v"]}
+        arrays.update({"cache/length": state["length"],
+                       "prompt_ids": st.prompt[None, :].astype(np.int32),
+                       "tokens": np.asarray(st.tokens, np.int32)[None, :]})
         meta = {"mode": self._ckpt_mode(), "model": _model_sig(self.cfg),
                 "sid": int(sid),
                 "step": int(st.t - 1), "rng_seed": int(st.rng_seed),
                 "temperature": float(st.temperature),
                 "max_new_tokens": int(st.max_new_tokens)}
+        if self.bcfg.kv_codec != "fp":
+            # fp checkpoints keep the pre-quantization meta key set, so old
+            # snapshots and fp batchers stay mutually restorable
+            meta["kv_codec"] = self.bcfg.kv_codec
         if self.rt is not None:
             # split payloads are per-stage rows — refuse restore onto a
             # different placement the same way recovery checkpoints do
@@ -789,6 +874,16 @@ class ContinuousBatcher:
             raise CheckpointError(
                 f"{path} was written for model {meta.get('model')!r}, this "
                 f"batcher runs {_model_sig(self.cfg)!r}")
+        ck = meta.get("kv_codec", "fp")
+        if ck != self.bcfg.kv_codec:
+            # REFUSAL, not transcode: the payload is raw pool bytes at the
+            # checkpoint's tier; rewriting them would silently change the
+            # stream's numerics mid-flight (paged_kv.load_state_dict makes
+            # the same call for whole-pool snapshots)
+            raise CheckpointError(
+                f"{path} stores {ck!r} KV pages, this batcher's pool is "
+                f"{self.bcfg.kv_codec!r}; restore into a batcher built at "
+                f"the checkpoint's tier (transcoding is refused)")
         if self.rt is not None:
             pipe = getattr(self.rt, "pipeline", None)
             want = {"cuts": [int(c) for c in self.rt.split.cuts],
@@ -807,8 +902,16 @@ class ContinuousBatcher:
                           rng_seed=int(meta["rng_seed"]))
         st = self._streams[sid]
         st.tokens = [int(x) for x in ckpt.arrays["tokens"][0]]
-        st.resume = {"k": ckpt.arrays["cache/k"], "v": ckpt.arrays["cache/v"],
-                     "length": int(ckpt.arrays["cache/length"])}
+        if ck != "fp":
+            st.resume = {"k_codes": ckpt.arrays["cache/k_codes"],
+                         "v_codes": ckpt.arrays["cache/v_codes"],
+                         "k_scale": ckpt.arrays["cache/k_scale"],
+                         "v_scale": ckpt.arrays["cache/v_scale"],
+                         "length": int(ckpt.arrays["cache/length"])}
+        else:
+            st.resume = {"k": ckpt.arrays["cache/k"],
+                         "v": ckpt.arrays["cache/v"],
+                         "length": int(ckpt.arrays["cache/length"])}
         return sid
 
     # -- reporting ---------------------------------------------------------

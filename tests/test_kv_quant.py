@@ -1,0 +1,474 @@
+"""KV-at-rest compression: quantized paged pools, packed round-trips.
+
+The load-bearing claims: (1) the ``fp`` tier IS the pre-quantization data
+path — same pool type, same compiled step, token-identical output; (2) on
+quantized tiers every page movement (COW fork, defrag, eviction, adopt,
+checkpoint) is a BYTE move of packed codes + scales, never a requantize,
+so gather -> adopt round-trips are bit-exact across any pool geometry and
+a stream's tokens survive eviction/restore unchanged; (3) cross-tier
+restore is REFUSED, not transcoded. Capacity math: the same byte budget
+buys proportionally more pages at a packed tier, which is the whole point.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from edgellm_tpu.models import init_params, tiny_config
+from edgellm_tpu.models.flash_attention import (dequantize_kv_rows,
+                                                paged_decode_attention,
+                                                paged_decode_attention_quant,
+                                                quantize_kv_rows)
+from edgellm_tpu.models.paged_kv import (KV_PAGE_CODECS, OutOfPages,
+                                         PagedKVCache, PrefixCacheConfig,
+                                         kv_page_bytes,
+                                         num_pages_for_bytes,
+                                         resolve_kv_codec)
+from edgellm_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+from edgellm_tpu.serve.decode import generate
+from edgellm_tpu.serve.recovery import CheckpointError
+
+CFG = tiny_config("qwen2", num_layers=4, hidden_size=32, num_heads=4,
+                  vocab_size=128)
+# fp geometry shared with tests/test_batching.py; quantized twins differ
+# ONLY in the kv_codec field, so admission/span math is identical
+BCFG = BatchingConfig(page_size=8, num_pages=17, max_slots=4,
+                      pages_per_slot=4)
+BCFG8 = dataclasses.replace(BCFG, kv_codec="int8_per_channel")
+BCFG4 = dataclasses.replace(BCFG, kv_codec="int4_per_channel")
+
+# pool-level tests use a 2-layer model: tier bookkeeping is layer-count
+# independent and the materialized pages stay tiny
+CFG2 = tiny_config("qwen2", num_layers=2, hidden_size=32, num_heads=4,
+                   vocab_size=128)
+PROMPT = list(range(100, 110))
+TIERS = ("int8_per_channel", "int4_per_channel")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(1))
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        1, CFG.vocab_size, size=n).astype(np.int32)
+
+
+def _solo(params, prompt, max_new, temp=0.0, seed=0):
+    out = generate(CFG, params, jnp.asarray(prompt)[None], max_new,
+                   capacity=BCFG.span, temperature=temp,
+                   rng_key=jax.random.key(seed))
+    return np.asarray(out)[0]
+
+
+def _seq(n, seed):
+    r = np.random.default_rng(seed)
+    shape = (CFG2.num_layers, n, CFG2.num_kv_heads, CFG2.head_dim)
+    return (jnp.asarray(r.standard_normal(shape), jnp.float32),
+            jnp.asarray(r.standard_normal(shape), jnp.float32))
+
+
+def _qcache(kv_codec, **kw):
+    kw.setdefault("num_pages", 13)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("pages_per_slot", 4)
+    return PagedKVCache(CFG2, kv_codec=kv_codec, **kw)
+
+
+def _packed_equal(a, b, rows=None):
+    for key in ("k_codes", "v_codes", "k_scale", "v_scale"):
+        x, y = np.asarray(a[key]), np.asarray(b[key])
+        if rows is not None:
+            x, y = x[:, :rows], y[:, :rows]
+        np.testing.assert_array_equal(x, y, err_msg=key)
+
+
+# ---------------------------------------------------------------------------
+# codec registry + capacity math
+# ---------------------------------------------------------------------------
+
+
+def test_codec_registry_refuses_unknown_tiers():
+    with pytest.raises(ValueError, match="unknown kv_codec"):
+        resolve_kv_codec("int2_per_galaxy")
+    assert resolve_kv_codec("fp").quantized is False
+    for t in TIERS:
+        assert resolve_kv_codec(t).quantized
+    with pytest.raises(ValueError, match="even head_dim"):
+        KV_PAGE_CODECS["int4_per_channel"].code_lanes(7)
+
+
+def test_page_bytes_and_budget_capacity_ratio():
+    hd = CFG2.head_dim
+    fp_row = hd * 4
+    assert KV_PAGE_CODECS["fp"].row_bytes(hd) == fp_row
+    assert KV_PAGE_CODECS["int8_per_channel"].row_bytes(hd) == hd + 4
+    assert KV_PAGE_CODECS["int4_per_channel"].row_bytes(hd) == hd // 2 + 4
+    fp_page = kv_page_bytes(CFG2, 4, "fp")
+    assert fp_page == 2 * CFG2.num_layers * 4 * CFG2.num_kv_heads * fp_row
+    # a fixed byte budget must buy >= 2x the pages at the packed tiers —
+    # the acceptance-gate concurrency multiplier comes straight from here
+    budget = 8 * fp_page
+    n_fp = num_pages_for_bytes(CFG2, budget, 4, "fp")
+    assert n_fp == 8
+    for t in TIERS:
+        assert num_pages_for_bytes(CFG2, budget, 4, t) >= 2 * n_fp
+    with pytest.raises(ValueError, match="page 0 is reserved"):
+        num_pages_for_bytes(CFG2, kv_page_bytes(CFG2, 4, "int4_per_channel"),
+                            4, "int4_per_channel")
+
+
+def test_out_of_pages_math_with_shrunken_pages():
+    # same budget, same request: the fp pool refuses what int4 admits
+    budget = 5 * kv_page_bytes(CFG2, 4, "fp")
+    geo = dict(page_size=4, max_slots=2, pages_per_slot=8,
+               materialize=False)
+    fp = PagedKVCache(CFG2, num_pages=num_pages_for_bytes(
+        CFG2, budget, 4, "fp"), kv_codec="fp", **geo)
+    q4 = PagedKVCache(CFG2, num_pages=num_pages_for_bytes(
+        CFG2, budget, 4, "int4_per_channel"), kv_codec="int4_per_channel",
+        **geo)
+    s = fp.alloc_slot()
+    with pytest.raises(OutOfPages):
+        fp.ensure(s, 20)          # 5 pages > the 4 the budget buys
+    fp.check_invariants()
+    for _ in range(2):            # int4: BOTH slots fit at the same bytes
+        q4.ensure(q4.alloc_slot(), 20)
+    q4.check_invariants()
+
+
+# ---------------------------------------------------------------------------
+# quantize / dequantize rows
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_quantize_roundtrip_error_bound_and_idempotence(tier):
+    qmax = {"int8_per_channel": 127, "int4_per_channel": 7}[tier]
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 9, 3, CFG2.head_dim)) * 3.0,
+                    jnp.float32)
+    x = x.at[0, 4].set(0.0)       # an all-zero row must survive exactly
+    codes, scales = quantize_kv_rows(x, tier)
+    y = dequantize_kv_rows(codes, scales, tier)
+    assert y.shape == x.shape and y.dtype == jnp.float32
+    # per-row absmax scaling: error <= half a quantization step, per row
+    step = np.asarray(scales)[..., None] / qmax
+    assert (np.abs(np.asarray(x - y)) <= 0.5 * step + 1e-6).all()
+    np.testing.assert_array_equal(np.asarray(y[0, 4]), 0.0)
+    assert float(scales[0, 4].max()) == 0.0
+    # requantizing the dequantized rows reproduces the SAME bytes — the
+    # property every byte-move path (COW, defrag, checkpoint) leans on
+    codes2, scales2 = quantize_kv_rows(y, tier)
+    np.testing.assert_array_equal(np.asarray(codes2), np.asarray(codes))
+    np.testing.assert_allclose(np.asarray(scales2), np.asarray(scales),
+                               rtol=1e-6)
+
+
+@pytest.mark.parametrize("tier", TIERS)
+def test_paged_quant_fallback_matches_dequantized_pool(tier):
+    # the quant decode-attention entrypoint == dequantize the WHOLE pool
+    # then the plain paged path, exactly (same contract graphlint executes)
+    npg, pgs, ms, pps = 5, 8, 2, 2
+    rng = np.random.default_rng(3)
+    kv = (npg * pgs, CFG2.num_kv_heads, CFG2.head_dim)
+    kq, ks = quantize_kv_rows(
+        jnp.asarray(rng.standard_normal(kv), jnp.float32), tier)
+    vq, vs = quantize_kv_rows(
+        jnp.asarray(rng.standard_normal(kv), jnp.float32), tier)
+    hdc = kq.shape[-1]
+    q = jnp.asarray(rng.standard_normal(
+        (ms, 1, CFG2.num_heads, CFG2.head_dim)), jnp.float32)
+    tab = jnp.asarray(rng.permutation(np.arange(1, npg))[:ms * pps]
+                      .reshape(ms, pps).astype(np.int32))
+    lens = jnp.asarray([pgs + 3, pgs - 2], jnp.int32)
+    got = paged_decode_attention_quant(
+        q, kq.reshape(npg, pgs, -1, hdc), vq.reshape(npg, pgs, -1, hdc),
+        ks.reshape(npg, pgs, -1), vs.reshape(npg, pgs, -1), tab, lens,
+        kv_codec=tier)
+    kf = dequantize_kv_rows(kq, ks, tier)
+    vf = dequantize_kv_rows(vq, vs, tier)
+    ref = paged_decode_attention(
+        q, kf.reshape(npg, pgs, -1, CFG2.head_dim),
+        vf.reshape(npg, pgs, -1, CFG2.head_dim), tab, lens)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+# ---------------------------------------------------------------------------
+# quantized pool surgery: adopt / gather / COW / defrag / state_dict
+# ---------------------------------------------------------------------------
+
+
+def test_packed_gather_adopt_roundtrip_across_geometry():
+    cache = _qcache("int8_per_channel")
+    s = cache.alloc_slot()
+    k, v = _seq(10, 0)
+    cache.adopt(s, k, v, 10)
+    cache.check_invariants()
+    packed = cache.gather_slot_packed(s)
+    # the dequantized view agrees with dequantizing the packed bytes
+    # (to fp rounding — XLA may fuse the scale multiply differently)
+    g = cache.gather_slot(s)
+    np.testing.assert_allclose(
+        g["k"], np.asarray(dequantize_kv_rows(
+            jnp.asarray(packed["k_codes"]), jnp.asarray(packed["k_scale"]),
+            "int8_per_channel")), rtol=1e-6, atol=1e-7)
+    # adopt_packed into a DIFFERENT pool geometry: bytes land unchanged
+    other = _qcache("int8_per_channel", num_pages=5, page_size=8,
+                    max_slots=2, pages_per_slot=2)
+    s2 = other.alloc_slot()
+    other.adopt_packed(s2, packed["k_codes"], packed["v_codes"],
+                       packed["k_scale"], packed["v_scale"],
+                       int(packed["length"]))
+    other.check_invariants()
+    _packed_equal(other.gather_slot_packed(s2), packed)
+    # the packed API is tier-gated in both directions
+    fp = _qcache("fp")
+    sf = fp.alloc_slot()
+    fp.adopt(sf, k, v, 10)
+    with pytest.raises(ValueError, match="quantized tiers"):
+        fp.gather_slot_packed(sf)
+    with pytest.raises(ValueError, match="quantized tiers"):
+        fp.adopt_packed(sf, packed["k_codes"], packed["v_codes"],
+                        packed["k_scale"], packed["v_scale"], 10)
+
+
+def test_quant_cow_fork_is_a_byte_move():
+    pcfg = PrefixCacheConfig(enabled=True, min_shared_block=1)
+    cache = _qcache("int4_per_channel", prefix_cache=pcfg)
+    s0 = cache.alloc_slot()
+    k0, v0 = _seq(10, 0)
+    cache.adopt(s0, k0, v0, 10)
+    assert cache.register_prefix(s0, PROMPT) == 3
+    donor = cache.gather_slot_packed(s0)
+    s1 = cache.alloc_slot()
+    assert cache.share_prefix(s1, PROMPT + [111, 112], max_tokens=11) == 10
+    k1, v1 = _seq(2, 1)
+    cache.adopt_rows(s1, k1, v1, 10, 12)   # forks the shared partial page
+    cache.check_invariants()
+    assert cache.prefix_counters["cow_forks"] == 1
+    # the fork copied codes AND scales: the sharer's first 10 rows are
+    # byte-identical to the donor's, and the donor is untouched
+    _packed_equal(cache.gather_slot_packed(s1), donor, rows=10)
+    _packed_equal(cache.gather_slot_packed(s0), donor)
+
+
+def test_defrag_with_packed_pages_preserves_bytes():
+    cache = _qcache("int8_per_channel")
+    slots, snaps = [], {}
+    for i, n in enumerate((10, 7, 12)):
+        s = cache.alloc_slot()
+        k, v = _seq(n, i)
+        cache.adopt(s, k, v, n)
+        slots.append(s)
+    cache.free_slot(slots[1])     # punch holes mid-pool
+    for s in (slots[0], slots[2]):
+        snaps[s] = cache.gather_slot_packed(s)
+    assert cache.defrag() > 0
+    cache.check_invariants()
+    for s, snap in snaps.items():
+        _packed_equal(cache.gather_slot_packed(s), snap)
+
+
+def test_state_dict_roundtrip_and_tier_refusal():
+    cache = _qcache("int8_per_channel")
+    s = cache.alloc_slot()
+    k, v = _seq(9, 4)
+    cache.adopt(s, k, v, 9)
+    state = cache.state_dict()
+    assert state["kv_codec"] == "int8_per_channel"
+    assert {"k_codes", "v_codes", "k_scale", "v_scale"} <= set(state)
+    twin = _qcache("int8_per_channel")
+    twin.load_state_dict(state)
+    twin.check_invariants()
+    _packed_equal(twin.gather_slot_packed(s), cache.gather_slot_packed(s))
+    np.testing.assert_array_equal(np.asarray(twin.pool.k),
+                                  np.asarray(cache.pool.k))
+    # cross-tier restore is refused in BOTH directions, never transcoded
+    with pytest.raises(ValueError, match="transcoding is refused"):
+        _qcache("fp").load_state_dict(state)
+    fp = _qcache("fp")
+    sf = fp.alloc_slot()
+    fp.adopt(sf, k, v, 9)
+    fp_state = fp.state_dict()
+    # fp checkpoints keep the pre-quantization key set
+    assert "kv_codec" not in fp_state and {"k", "v"} <= set(fp_state)
+    with pytest.raises(ValueError, match="transcoding is refused"):
+        _qcache("int8_per_channel").load_state_dict(fp_state)
+
+
+# ---------------------------------------------------------------------------
+# quantized continuous batching
+# ---------------------------------------------------------------------------
+
+
+def test_fp_tier_is_default_and_token_identical(params):
+    assert BatchingConfig().kv_codec == "fp"
+    with pytest.raises(ValueError, match="unknown kv_codec"):
+        BatchingConfig(kv_codec="float13")
+    bat = ContinuousBatcher(CFG, params, dataclasses.replace(
+        BCFG, kv_codec="fp"))
+    assert not hasattr(bat.pool.pool, "k_scale")   # plain fp PagePool
+    p = _prompt(7, 40)
+    sid = bat.submit(p, 5, temperature=0.7, rng_seed=3)
+    np.testing.assert_array_equal(bat.run()[sid],
+                                  _solo(params, p, 5, 0.7, 3))
+
+
+def test_mixed_tiers_coexist_in_process(params):
+    # one process, three batchers at three tiers over the SAME geometry:
+    # jit caches are keyed by tier, pools never mix, everything drains
+    streams = [dict(prompt=_prompt(6, 50), max_new=5, temp=0.0, seed=7),
+               dict(prompt=_prompt(11, 51), max_new=4, temp=0.8, seed=8)]
+    for bcfg in (BCFG, BCFG8, BCFG4):
+        bat = ContinuousBatcher(CFG, params, bcfg)
+        sids = [bat.submit(s["prompt"], s["max_new"],
+                           temperature=s["temp"], rng_seed=s["seed"])
+                for s in streams]
+        results = bat.run()
+        for sid, s in zip(sids, streams):
+            assert len(results[sid]) == s["max_new"]
+        rep = bat.report()
+        assert rep["finished"] == len(streams) and rep["evicted"] == 0
+        if bcfg.kv_codec == "fp":   # fp tier stays bit-identical to solo
+            for sid, s in zip(sids, streams):
+                np.testing.assert_array_equal(
+                    results[sid], _solo(params, s["prompt"], s["max_new"],
+                                        s["temp"], s["seed"]))
+
+
+def test_quant_eviction_readmit_bit_identical(params):
+    # pool too small for all three quant streams: the evicted stream's
+    # pages leave as PACKED bytes and come back as the same bytes, so its
+    # tokens match the uncontended run of the SAME tier exactly
+    streams = [dict(prompt=_prompt(15, 60), max_new=8, temp=0.0, seed=1),
+               dict(prompt=_prompt(14, 61), max_new=8, temp=0.9, seed=2),
+               dict(prompt=_prompt(13, 62), max_new=8, temp=0.0, seed=3)]
+    ref = {}
+    roomy = ContinuousBatcher(CFG, params, BCFG8)
+    for i, s in enumerate(streams):
+        sid = roomy.submit(s["prompt"], s["max_new"],
+                           temperature=s["temp"], rng_seed=s["seed"])
+        ref[i] = roomy.run()[sid]
+    tight = ContinuousBatcher(CFG, params, dataclasses.replace(
+        BCFG8, num_pages=8))          # 7 allocatable pages
+    sids = [tight.submit(s["prompt"], s["max_new"], temperature=s["temp"],
+                         rng_seed=s["seed"]) for s in streams]
+    results = tight.run()
+    assert tight.report()["evicted"] > 0
+    for i, sid in enumerate(sids):
+        np.testing.assert_array_equal(results[sid], ref[i])
+
+
+def test_quant_checkpoint_restore_across_geometry(params, tmp_path):
+    p = _prompt(7, 70)
+    ref = ContinuousBatcher(CFG, params, BCFG8)
+    ref_sid = ref.submit(p, 8, temperature=0.6, rng_seed=42)
+    want = ref.run()[ref_sid]
+    bat = ContinuousBatcher(CFG, params, BCFG8)
+    sid = bat.submit(p, 8, temperature=0.6, rng_seed=42)
+    for _ in range(4):
+        bat.step()
+    path = bat.checkpoint_stream(sid, str(tmp_path / "q.ckpt"))
+    # a DIFFERENT pool geometry at the same tier: the payload is packed
+    # rows, not pages, so the restored stream finishes bit-identically
+    other = ContinuousBatcher(CFG, params, dataclasses.replace(
+        BCFG8, page_size=4, num_pages=33, max_slots=2, pages_per_slot=8))
+    rid = other.restore_stream(path)
+    np.testing.assert_array_equal(other.run()[rid], want)
+
+
+def test_quant_checkpoint_cross_tier_restore_refused(params, tmp_path):
+    bat = ContinuousBatcher(CFG, params, BCFG8)
+    sid = bat.submit(_prompt(5, 80), 4)
+    bat.step()
+    qpath = bat.checkpoint_stream(sid, str(tmp_path / "q.ckpt"))
+    with pytest.raises(CheckpointError, match="transcoding is refused"):
+        ContinuousBatcher(CFG, params, BCFG).restore_stream(qpath)
+    with pytest.raises(CheckpointError, match="transcoding is refused"):
+        ContinuousBatcher(CFG, params, BCFG4).restore_stream(qpath)
+    fbat = ContinuousBatcher(CFG, params, BCFG)
+    fsid = fbat.submit(_prompt(5, 81), 4)
+    fbat.step()
+    fpath = fbat.checkpoint_stream(fsid, str(tmp_path / "f.ckpt"))
+    with pytest.raises(CheckpointError, match="transcoding is refused"):
+        ContinuousBatcher(CFG, params, BCFG8).restore_stream(fpath)
+
+
+# ---------------------------------------------------------------------------
+# split runtime: per-stage quant pools move the same bytes
+# ---------------------------------------------------------------------------
+
+
+def test_split_quant_pool_packed_roundtrip(params):
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    from edgellm_tpu.parallel import SplitConfig, SplitRuntime, \
+        make_stage_mesh
+
+    rt = SplitRuntime(CFG, SplitConfig(cuts=(2,), hop_codecs=("fp32",)),
+                      make_stage_mesh(2))
+    placed = rt.place_params(params)
+    ps, npg = 8, 9
+    host = PagedKVCache(CFG, num_pages=npg, page_size=ps, max_slots=2,
+                        pages_per_slot=4, materialize=False,
+                        kv_codec="int8_per_channel")
+    pool = rt.init_paged_pool(npg, ps, kv_codec="int8_per_channel")
+    prompt = _prompt(9, 90)
+    _, cache = rt.prefill_decode(placed, jnp.asarray(prompt)[None], 32)
+    slot = host.alloc_slot()
+    host.ensure(slot, len(prompt))
+    dest = host._flat_indices(slot, len(prompt))
+    pool = rt.adopt_paged(pool, cache, 0, dest, len(prompt))
+    host.lengths[slot] = len(prompt)
+    packed = rt.gather_paged_packed(pool, dest)
+    # readmit the SAME bytes at a different placement in a fresh pool
+    pool2 = rt.init_paged_pool(npg, ps, kv_codec="int8_per_channel")
+    host2 = PagedKVCache(CFG, num_pages=npg, page_size=ps, max_slots=2,
+                         pages_per_slot=4, materialize=False,
+                         kv_codec="int8_per_channel")
+    host2.alloc_slot()
+    s2 = host2.alloc_slot()       # slot 1: different page placement
+    host2.ensure(s2, len(prompt))
+    dest2 = host2._flat_indices(s2, len(prompt))
+    pool2 = rt.adopt_paged_rows_packed(pool2, *packed, dest2)
+    back = rt.gather_paged_packed(pool2, dest2)
+    for a, b in zip(packed, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # the dequantized gather form stays finite (suffix-prefill compute path)
+    rows_k, rows_v = rt.gather_paged(pool, dest)
+    assert np.isfinite(rows_k).all() and np.isfinite(rows_v).all()
+    # the packed APIs are tier-gated on fp pools
+    fpool = rt.init_paged_pool(npg, ps)
+    with pytest.raises(ValueError, match="quantized"):
+        rt.gather_paged_packed(fpool, dest)
+    with pytest.raises(ValueError, match="quantized"):
+        rt.adopt_paged_rows_packed(fpool, *packed, dest2)
+
+
+# ---------------------------------------------------------------------------
+# eval harness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_kv_tier_eval_sweep_bounds(params):
+    from edgellm_tpu.eval.split_eval import run_kv_tier_sweep
+
+    corpus = np.random.default_rng(0).integers(
+        1, CFG.vocab_size, size=256).astype(np.int32)
+    rows = run_kv_tier_sweep(CFG, params, corpus,
+                             tiers=("fp", "int8_per_channel"),
+                             max_length=32, stride=32, page_size=8,
+                             window_batch=2, max_chunks=2)
+    by = {r["kv_codec"]: r for r in rows}
+    assert by["fp"]["ppl_delta_vs_fp"] == 0.0
+    assert abs(by["int8_per_channel"]["ppl_delta_vs_fp"]) < 0.01
+    assert (by["int8_per_channel"]["kv_page_bytes"]
+            < by["int8_per_channel"]["kv_page_bytes_fp"])
+    assert all(np.isfinite(r["ppl"]) for r in rows)
