@@ -108,6 +108,32 @@ BENCH_SOAK_DEADLINE_S (virtual-seconds deadline per request, default 60),
 BENCH_SOAK_CORRUPT (burst-window per-attempt drop rate, default 0.2),
 BENCH_SOAK_SEED, plus the shared BENCH_MODEL / BENCH_DTYPE.
 
+BENCH_CLUSTER=1 switches to the replica-router acceptance surface (see
+``cluster_main``), two legs in one section. Leg (a), real model: a
+2-replica fleet of continuous-batching ServeFronts behind the
+prefix-affinity router, replica 0 killed mid-workload, and the SAME
+request plan rerun on a fault-free single replica — every completed
+request must be token-identical to the rerun (greedy AND sampled via
+recorded seeds), every record must report zero decode-step jit misses
+(one warm twin heats the fleet's shared cache), and the kill must dump
+exactly one flight-recorder post-mortem. Leg (b), simulated scale: the
+discrete-event chaos soak (``run_cluster_soak``) at
+BENCH_CLUSTER_REQUESTS (default 1_000_000) over BENCH_CLUSTER_REPLICAS
+(default 4) simulated replicas with two scheduled kills and a
+link-corruption burst, plus two fault-free control runs of the same
+arrival plan — the same fleet, and a single replica at equal TOTAL
+capacity (per-token service times divided by N, queue depth multiplied
+by N). Gates, all in the headline line: chaos-run token identity, zero
+accepted loss, exactly one flight dump per induced kill, outage-window
+goodput >= 90% of the no-fault run (per kill, over
+BENCH_CLUSTER_OUTAGE_S virtual seconds from the kill), and no-fault
+fleet goodput/SLO no worse than the equal-capacity single replica.
+Knobs: BENCH_CLUSTER_REQUESTS, BENCH_CLUSTER_REPLICAS,
+BENCH_CLUSTER_RATE (virtual arrivals/s, default 80), BENCH_CLUSTER_SEED,
+BENCH_CLUSTER_OUTAGE_S (default 10), BENCH_CLUSTER_REAL (0 skips the
+real-model leg), BENCH_CLUSTER_REAL_REQUESTS (default 12), plus the
+shared BENCH_MODEL / BENCH_DTYPE.
+
 BENCH_SERVE=1 switches to the continuous-batching workload (see
 ``serve_main``): the SAME seeded Poisson open-loop arrival trace is served
 twice on a virtual clock — once by the paged continuous batcher (streams
@@ -2020,6 +2046,288 @@ def soak_main():
     _emit(line, detail)
 
 
+def cluster_main():
+    """BENCH_CLUSTER=1: replica-router acceptance — real-model mini fleet
+    with a mid-workload kill, then the million-request simulated chaos
+    soak with its no-fault and equal-capacity-single-replica controls.
+
+    Every gate the CI job enforces is computed here and carried in the
+    headline line: chaos-run token identity vs the fault-free same-plan
+    replay, zero accepted loss, exactly one flight dump per induced kill,
+    zero decode-step jit misses on the real fleet, outage-window goodput
+    >= 90% of the no-fault run, and no-fault fleet goodput/SLO no worse
+    than a single replica at equal total capacity."""
+    import dataclasses
+    import tempfile
+
+    import numpy as np
+    from edgellm_tpu.obs.metrics import record_cluster_stats
+    from edgellm_tpu.serve.cluster import (ClusterConfig, ClusterFront,
+                                           RespawnConfig, SimReplicaConfig,
+                                           SimReplicaFront)
+    from edgellm_tpu.serve.frontend import Request
+    from edgellm_tpu.serve.soak import ClusterSoakConfig, run_cluster_soak
+    from edgellm_tpu.utils.clock import FakeClock
+
+    seed = int(os.environ.get("BENCH_CLUSTER_SEED", "0"))
+    tmpdir = tempfile.mkdtemp(prefix="bench_cluster_")
+
+    # -- leg (a): real-model 2-replica fleet, mid-workload kill ------------
+
+    def real_leg() -> dict:
+        import jax
+        import jax.numpy as jnp
+        from edgellm_tpu.models import PRESETS, init_params
+        from edgellm_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+        from edgellm_tpu.serve.frontend import ServeFront
+
+        model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+        cfg = PRESETS[model_name]
+        dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+            os.environ.get("BENCH_DTYPE", "bfloat16")]
+        n = int(os.environ.get("BENCH_CLUSTER_REAL_REQUESTS", "12"))
+        prompt_len, new_tokens, shared_len = 16, 8, 8
+        params = init_params(cfg, jax.random.key(0), dtype=dtype)
+
+        page_size = 8
+        pages_per_slot = -(-(prompt_len + new_tokens) // page_size)
+        max_slots = 4
+        bcfg = BatchingConfig(page_size=page_size, max_slots=max_slots,
+                              num_pages=1 + max_slots * pages_per_slot,
+                              pages_per_slot=pages_per_slot,
+                              compute_dtype=dtype)
+        # one warm run heats the process-global batched-step jit cache for
+        # the whole fleet: every replica (including post-kill respawns)
+        # reuses the same executables, so the steady-state gate is ZERO
+        # misses on every record
+        warm = ContinuousBatcher(cfg, params, bcfg)
+        warm.submit(np.ones((prompt_len,), np.int32), 2, temperature=0.7)
+        warm.run()
+
+        rng = np.random.default_rng(seed)
+        shared_pfx = rng.integers(1, cfg.vocab_size,
+                                  size=shared_len).astype(np.int32)
+        prompts = []
+        for _ in range(n):
+            p = rng.integers(1, cfg.vocab_size,
+                             size=prompt_len).astype(np.int32)
+            p[:shared_len] = shared_pfx
+            prompts.append(p)
+        gaps = rng.exponential(0.5, size=n)
+
+        def make_req(i: int) -> Request:
+            # half greedy, half sampled through the recorded seed — the
+            # identity gate must hold at temperature > 0 too
+            sampled = i % 2 == 1
+            return Request(prompt_ids=prompts[i].copy(),
+                           max_new_tokens=new_tokens,
+                           temperature=0.7 if sampled else 0.0,
+                           rng_seed=100 + i if sampled else 0,
+                           deadline_s=600.0)
+
+        def run_fleet(n_replicas: int, kill_at) -> tuple:
+            clock = FakeClock()
+
+            def factory(rid, gen):
+                return ServeFront(cfg, params, clock=clock,
+                                  batcher=ContinuousBatcher(cfg, params,
+                                                            bcfg))
+
+            cluster = ClusterFront(
+                factory,
+                ClusterConfig(
+                    num_replicas=n_replicas, min_affinity_tokens=shared_len,
+                    flight_dir=os.path.join(
+                        tmpdir, f"real_{n_replicas}r_{kill_at}"),
+                    respawn=RespawnConfig(backoff_base_s=0.5,
+                                          jitter_frac=0.0)),
+                clock=clock)
+            by_req: dict = {}
+            records = []
+            for i in range(n):
+                if kill_at is not None and i == kill_at:
+                    # queues have built up (no drain yet): the kill must
+                    # re-admit replica 0's queued work elsewhere with zero
+                    # accepted loss
+                    cluster.kill_replica(0, "chaos")
+                clock.advance(float(gaps[i]))
+                by_req[cluster.submit(make_req(i))] = i
+            while True:
+                recs = cluster.drain()
+                if recs:
+                    records.extend(recs)
+                    continue
+                if not cluster.pending:
+                    break
+                ev = cluster.next_event_s()
+                if ev is None:
+                    break
+                clock.set_time(max(ev, clock.now))
+            assert cluster.pending == 0, (
+                f"real fleet lost {cluster.pending} accepted request(s)")
+            return records, by_req, cluster
+
+        chaos_recs, chaos_map, chaos_cluster = run_fleet(2, kill_at=n // 2)
+        ref_recs, ref_map, _ = run_fleet(1, kill_at=None)
+
+        def toks(r) -> list:
+            return (np.asarray(r.tokens).reshape(-1).tolist()
+                    if r.tokens is not None else None)
+
+        ref_tokens = {ref_map[r.request_id]: toks(r) for r in ref_recs}
+        completed = sum(1 for r in chaos_recs if r.outcome == "completed")
+        mismatched = [
+            chaos_map[r.request_id] for r in chaos_recs
+            if r.outcome == "completed"
+            and toks(r) != ref_tokens.get(chaos_map[r.request_id])]
+        jit_max = max((r.jit_misses or 0) for r in chaos_recs)
+        dumps = chaos_cluster.flight_dumps()
+        rep = chaos_cluster.report()
+        outcomes: dict = {}
+        for r in chaos_recs:
+            outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
+        return {
+            "model": model_name, "requests": n,
+            "completed": completed,
+            "outcomes": outcomes,
+            "identity_ok": completed == n and not mismatched,
+            "mismatched": mismatched,
+            "jit_misses_max": jit_max,
+            "flight_dumps": len(dumps),
+            "readmitted": rep["totals"]["readmitted"],
+            "report": rep,
+        }
+
+    real = None
+    if os.environ.get("BENCH_CLUSTER_REAL", "1") == "1":
+        real = real_leg()
+
+    # -- leg (b): simulated chaos soak + controls --------------------------
+
+    n_sim = int(os.environ.get("BENCH_CLUSTER_REQUESTS", "1000000"))
+    replicas = int(os.environ.get("BENCH_CLUSTER_REPLICAS", "4"))
+    rate = float(os.environ.get("BENCH_CLUSTER_RATE", "80.0"))
+    outage_s = float(os.environ.get("BENCH_CLUSTER_OUTAGE_S", "10.0"))
+    soak = ClusterSoakConfig(
+        n_requests=n_sim, arrival_rate=rate, seed=seed,
+        prompt_len=16, shared_prefix_len=8, num_prefix_groups=32,
+        max_new_tokens=16, deadline_s=120.0,
+        sampled_frac=0.5, sample_temperature=0.7,
+        kills=((0.3, 0), (0.6, 1)),
+        burst_start_frac=0.45, burst_end_frac=0.55,
+        burst_corrupt_rate=0.05)
+
+    def sim_run(n_replicas: int, scfg: SimReplicaConfig,
+                soak_cfg: ClusterSoakConfig, tag: str) -> dict:
+        clock = FakeClock()
+
+        def factory(rid, gen):
+            return SimReplicaFront(scfg, clock=clock, replica_id=rid)
+
+        cluster = ClusterFront(
+            factory,
+            ClusterConfig(num_replicas=n_replicas,
+                          flight_dir=os.path.join(tmpdir, f"sim_{tag}"),
+                          respawn=RespawnConfig(backoff_base_s=0.5,
+                                                jitter_seed=seed)),
+            clock=clock)
+        return run_cluster_soak(cluster, soak_cfg, clock=clock)
+
+    base_sim = SimReplicaConfig()
+    calm = dataclasses.replace(soak, kills=(), burst_start_frac=0.0,
+                               burst_end_frac=0.0, burst_corrupt_rate=0.0,
+                               verify_identity=False)
+    chaos = sim_run(replicas, base_sim, soak, "chaos")
+    nofault = sim_run(replicas, base_sim, calm, "nofault")
+    # the single-replica control at equal TOTAL capacity: one front whose
+    # per-token service times are the fleet's divided by N and whose queue
+    # holds the fleet's combined depth — the router must not cost goodput
+    # or SLO relative to it
+    single_cfg = dataclasses.replace(
+        base_sim,
+        prefill_s_per_token=base_sim.prefill_s_per_token / replicas,
+        decode_s_per_token=base_sim.decode_s_per_token / replicas,
+        max_queue_depth=base_sim.max_queue_depth * replicas)
+    baseline = sim_run(1, single_cfg, calm, "baseline")
+    record_cluster_stats(chaos["report"])
+
+    width = float(chaos["goodput_buckets"]["width_s"])
+
+    def window_tokens(art: dict, t0: float, t1: float) -> int:
+        toks = art["goodput_buckets"]["tokens"]
+        b0, b1 = int(t0 / width), int(t1 / width)
+        return sum(v for b, v in toks.items() if b0 <= int(b) <= b1)
+
+    # per-kill outage window: chaos goodput over [kill, kill + outage_s]
+    # vs the SAME virtual window of the no-fault run of the same arrival
+    # plan; the gate is the worst kill's fraction
+    outage = []
+    for ev in chaos["kills"]:
+        t0 = float(ev["at_s"])
+        lost = window_tokens(chaos, t0, t0 + outage_s)
+        ref = window_tokens(nofault, t0, t0 + outage_s)
+        outage.append({"replica": ev["replica"], "at_s": t0,
+                       "chaos_tokens": lost, "nofault_tokens": ref,
+                       "frac": (lost / ref) if ref else None})
+    outage_frac = min((o["frac"] for o in outage if o["frac"] is not None),
+                      default=None)
+
+    goodput_vs_single = (nofault["goodput_tokens_per_s"]
+                         / max(baseline["goodput_tokens_per_s"], 1e-9))
+    slo_vs_single = ((nofault["slo_attainment"] or 0.0)
+                     - (baseline["slo_attainment"] or 0.0))
+    identity = chaos["token_identity"]
+    gates = {
+        "token_identity_ok": bool(identity["ok"] and identity["checked"]),
+        "zero_accepted_loss": sum(chaos["outcomes"].values()) == n_sim,
+        "flight_dumps_exactly_once":
+            len(chaos["flight_dumps"]) == len(soak.kills),
+        "respawned_through_probes": chaos["respawns"] == len(soak.kills),
+        "outage_goodput_ge_90pct":
+            outage_frac is not None and outage_frac >= 0.9,
+        "goodput_ge_single_replica": goodput_vs_single >= 0.95,
+        "slo_ge_single_replica": slo_vs_single >= -0.01,
+    }
+    if real is not None:
+        gates["real_identity_ok"] = bool(real["identity_ok"])
+        gates["real_jit_misses_zero"] = real["jit_misses_max"] == 0
+        gates["real_flight_dumps_exactly_once"] = real["flight_dumps"] == 1
+
+    detail = {
+        "chaos": chaos, "nofault": nofault, "baseline_single": baseline,
+        "outage_windows": outage, "outage_window_s": outage_s,
+        "real": real, "gates": gates,
+    }
+    line = {
+        "metric": (f"{replicas}-replica cluster chaos soak goodput "
+                   f"({n_sim} reqs at {rate}/s virtual, "
+                   f"{len(soak.kills)} kills, burst "
+                   f"{soak.burst_corrupt_rate})"),
+        "value": round(chaos["goodput_tokens_per_s"], 2),
+        "unit": "goodput tokens/s (virtual)",
+        "vs_baseline": round(goodput_vs_single, 4),
+        "slo_attainment": chaos["slo_attainment"],
+        "outage_goodput_frac": (None if outage_frac is None
+                                else round(outage_frac, 4)),
+        "token_identity_ok": gates["token_identity_ok"],
+        "identity_checked": identity["checked"],
+        "flight_dumps": len(chaos["flight_dumps"]),
+        "kills": len(soak.kills),
+        "respawns": chaos["respawns"],
+        "readmitted": chaos["readmitted"],
+        "recompute_tokens": chaos["recompute_tokens"],
+        "real_identity_ok": None if real is None else real["identity_ok"],
+        "real_jit_misses_max": (None if real is None
+                                else real["jit_misses_max"]),
+        "real_flight_dumps": None if real is None else real["flight_dumps"],
+        "gates_ok": all(gates.values()),
+    }
+    _emit(line, detail)
+    if not all(gates.values()):
+        failed = sorted(k for k, v in gates.items() if not v)
+        raise SystemExit(f"cluster bench gates failed: {failed}")
+
+
 def _backend_unavailable(exc: BaseException) -> bool:
     """True when the error is an accelerator-backend outage (the tunneled
     TPU plugin failing to come up), not a code bug in the bench."""
@@ -2078,6 +2386,8 @@ def main():
         return _run_section("fec", fec_main)
     if os.environ.get("BENCH_SOAK") == "1":
         return _run_section("soak", soak_main)
+    if os.environ.get("BENCH_CLUSTER") == "1":
+        return _run_section("cluster", cluster_main)
     if os.environ.get("BENCH_SERVE") == "1":
         return _run_section("serve", serve_main)
     if os.environ.get("BENCH_PREFIX") == "1":

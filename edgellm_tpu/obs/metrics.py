@@ -599,6 +599,53 @@ def record_probe_decisions(rows: Optional[Sequence[Mapping[str, Any]]],
         win.set(0.0 if w is None else (1.0 if w else -1.0), codec=codec)
 
 
+def record_cluster_stats(report: Optional[Mapping[str, Any]],
+                         registry: Optional[MetricsRegistry] = None) -> None:
+    """Absorb a :meth:`~edgellm_tpu.serve.cluster.ClusterFront.report` dict
+    as ``edgellm_cluster_*`` series: fleet-size/pressure/parked gauges plus
+    kill/respawn/readmission/recompute counters (incremented with the
+    report's running totals — call once per report, not per drain tick)."""
+    reg = registry if registry is not None else _REGISTRY
+    if not reg.enabled or not report:
+        return
+    replicas = report.get("replicas", {})
+    live = sum(1 for r in replicas.values() if r.get("state") == "live")
+    reg.gauge("edgellm_cluster_replicas",
+              "replicas in the fleet (any state)").set(float(len(replicas)))
+    reg.gauge("edgellm_cluster_live_replicas",
+              "replicas currently serving").set(float(live))
+    reg.gauge("edgellm_cluster_parked",
+              "accepted requests waiting for a routable replica").set(
+        float(report.get("parked", 0)))
+    pressure = report.get("pressure")
+    if pressure is not None:
+        reg.gauge("edgellm_cluster_pressure",
+                  "mean live-replica load fraction").set(float(pressure))
+    kills = report.get("kills")
+    if kills:
+        reg.counter("edgellm_cluster_kills_total",
+                    "replicas removed by fault or chaos").inc(len(kills))
+    respawns = sum(r.get("respawns", 0) for r in replicas.values())
+    if respawns:
+        reg.counter("edgellm_cluster_respawns_total",
+                    "replica respawns from a clean plan").inc(int(respawns))
+    totals = report.get("totals", {})
+    if totals.get("readmitted"):
+        reg.counter("edgellm_cluster_readmitted_total",
+                    "accepted requests re-placed after a replica loss").inc(
+            int(totals["readmitted"]))
+    if totals.get("recompute_tokens"):
+        reg.counter("edgellm_cluster_recompute_tokens_total",
+                    "tokens regenerated after scratch re-admissions").inc(
+            int(totals["recompute_tokens"]))
+    events = report.get("autoscale_events")
+    if events:
+        c = reg.counter("edgellm_cluster_autoscale_events_total",
+                        "autoscaler scale decisions")
+        for ev in events:
+            c.inc(direction=ev.get("direction", "?"))
+
+
 def format_table(registry: Optional[MetricsRegistry] = None,
                  title: str = "metrics") -> str:
     """One aligned name/value table over the whole registry — the unified

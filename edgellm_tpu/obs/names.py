@@ -69,6 +69,17 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     # tracing plane
     "edgellm_flight_dumps_total",
     "edgellm_obs_scrapes_total",
+    # cluster router (serve/cluster.py)
+    "edgellm_cluster_replicas",
+    "edgellm_cluster_live_replicas",
+    "edgellm_cluster_pressure",
+    "edgellm_cluster_parked",
+    "edgellm_cluster_placements_total",
+    "edgellm_cluster_kills_total",
+    "edgellm_cluster_respawns_total",
+    "edgellm_cluster_readmitted_total",
+    "edgellm_cluster_recompute_tokens_total",
+    "edgellm_cluster_autoscale_events_total",
 })
 
 #: templates for adapter families whose middle segment is a runtime key
@@ -115,6 +126,11 @@ SPAN_NAMES: FrozenSet[str] = frozenset({
     "eval.time_decode_hops",
     # lint graph-layer probe
     "lint.obs-identity-probe",
+    # serve/cluster.py replica lifecycle (rare paths only — the router's
+    # per-request hot path stays span-free for the 10⁶-request soak)
+    "cluster.kill",
+    "cluster.respawn",
+    "cluster.autoscale",
 })
 
 #: span-name templates (none yet — span names are all static today); kept so

@@ -119,6 +119,7 @@ _PARAM_KEYS = {
     "prefix_cache": "serve",
     "kv_at_rest": "serve",
     "speculative": "serve",
+    "cluster": "serve",
     "max_compiles": "distances",
     "observability": "all",
 }
@@ -506,6 +507,55 @@ def _validate_params_json(p: dict) -> None:
             die("speculative runs the one-stream spec loop; the batcher's "
                 "ragged step verifies one token per slot — drop "
                 "'speculative' or 'batching'")
+    if "cluster" in p:
+        from .serve.cluster import (AutoscalerConfig, ClusterConfig,
+                                    RespawnConfig)
+        from .serve.overload import BreakerConfig, RetryBudgetConfig
+
+        if exp != "serve":
+            die("cluster only applies to experiment 'serve'")
+        if "batching" not in p:
+            die("cluster replicas each run the continuous batcher — add a "
+                "'batching' block")
+        if "speculative" in p:
+            die("cluster + speculative: the spec loop is single-stream with "
+                "no replica routing story — drop one of the two blocks")
+        cl = p["cluster"]
+        if not isinstance(cl, dict):
+            die(f"cluster must be an object of ClusterConfig fields, "
+                f"got {cl!r}")
+        top = {f.name for f in dataclasses.fields(ClusterConfig)}
+        bad = sorted(set(cl) - top)
+        if bad:
+            die(f"cluster: unknown field(s) {bad}; known: {sorted(top)}")
+        for key, cls in (("breaker", BreakerConfig),
+                         ("retry_budget", RetryBudgetConfig),
+                         ("respawn", RespawnConfig),
+                         ("autoscaler", AutoscalerConfig)):
+            if key not in cl:
+                continue
+            if not isinstance(cl[key], dict):
+                die(f"cluster.{key} must be an object of {cls.__name__} "
+                    f"fields, got {cl[key]!r}")
+            fields = {f.name for f in dataclasses.fields(cls)}
+            bad = sorted(set(cl[key]) - fields)
+            if bad:
+                die(f"cluster.{key}: unknown field(s) {bad}; "
+                    f"known: {sorted(fields)}")
+        try:
+            ccfg = _cluster_config(cl)
+        except (TypeError, ValueError) as e:
+            die(f"cluster: {e}")
+        if ccfg.num_replicas < 2:
+            die(f"cluster.num_replicas must be >= 2 (a one-replica cluster "
+                f"is the plain serve front — drop the 'cluster' block), "
+                f"got {ccfg.num_replicas}")
+        if (p.get("serving", {}).get("soak") or {}).get(
+                "kill_stage") is not None:
+            die("cluster + serving.soak.kill_stage: the stage kill is the "
+                "single-front chaos hook — replica kills belong to the "
+                "router (ClusterFront.kill_replica, exercised by the "
+                "cluster tests/bench)")
 
 
 def _pipeline_config(p: dict):
@@ -537,6 +587,25 @@ def _serve_front_config(sv: dict):
         if key in kwargs:
             kwargs[key] = cls(**kwargs[key])
     return ServeFrontConfig(**kwargs)
+
+
+def _cluster_config(cl: dict):
+    """Build the :class:`ClusterConfig` a ``"cluster"`` params block
+    describes — nested policy objects (breaker, retry budget, respawn
+    backoff, autoscaler bounds) become the matching sub-configs. Raises
+    ``TypeError``/``ValueError``/``ClusterConfigError`` on bad fields; the
+    validator turns those into field-naming ``die()``s."""
+    from .serve.cluster import AutoscalerConfig, ClusterConfig, RespawnConfig
+    from .serve.overload import BreakerConfig, RetryBudgetConfig
+
+    kwargs = dict(cl)
+    for key, cls in (("breaker", BreakerConfig),
+                     ("retry_budget", RetryBudgetConfig),
+                     ("respawn", RespawnConfig),
+                     ("autoscaler", AutoscalerConfig)):
+        if key in kwargs:
+            kwargs[key] = cls(**kwargs[key])
+    return ClusterConfig(**kwargs)
 
 
 def _attach_front_obs(front) -> None:
@@ -999,6 +1068,80 @@ def main(argv=None) -> int:
                 if rt is not None:
                     split_kw = dict(split_runtime=rt,
                                     placed_params=rt.place_params(params))
+                if "cluster" in params_json:
+                    # replica-router path (REPRODUCING §20): N continuous-
+                    # batching fronts behind prefix-affinity placement; every
+                    # replica shares the (already-compiled) step plan, so one
+                    # warm run heats the whole fleet's jit cache
+                    from .obs.metrics import record_cluster_stats
+                    from .serve.cluster import ClusterFront
+                    from .serve.frontend import Request
+
+                    ccfg = _cluster_config(params_json["cluster"])
+
+                    def replica_factory(replica_id, generation):
+                        b = ContinuousBatcher(cfg, params, bcfg, **split_kw)
+                        return ServeFront(cfg, params, config=front_cfg,
+                                          clock=clock, batcher=b)
+
+                    cluster = ClusterFront(replica_factory, ccfg,
+                                           clock=clock)
+                    _attach_front_obs(cluster)
+                    warm = ContinuousBatcher(cfg, params, bcfg, **split_kw)
+                    warm.submit(np.ones((soak.prompt_len,), np.int32), 2)
+                    warm.run()
+                    rng = np.random.default_rng(soak.seed)
+                    gaps = rng.exponential(1.0 / soak.arrival_rate,
+                                           size=soak.n_requests)
+                    shared_pfx = (rng.integers(
+                        1, cfg.vocab_size,
+                        size=soak.shared_prefix_len).astype(np.int32)
+                        if soak.shared_prefix_len else None)
+                    records = []
+                    for i in range(soak.n_requests):
+                        clock.advance(float(gaps[i]))
+                        pi = rng.integers(1, cfg.vocab_size,
+                                          size=soak.prompt_len
+                                          ).astype(np.int32)
+                        if shared_pfx is not None:
+                            pi[:soak.shared_prefix_len] = shared_pfx
+                        cluster.submit(Request(
+                            prompt_ids=pi,
+                            max_new_tokens=soak.max_new_tokens,
+                            temperature=soak.temperature,
+                            deadline_s=soak.deadline_s, rng_seed=i))
+                    while True:
+                        recs = cluster.drain()
+                        if not recs:
+                            break
+                        records.extend(recs)
+                    rep = cluster.report()
+                    record_cluster_stats(rep)
+                    outcomes = {}
+                    for rec in records:
+                        outcomes[rec.outcome] = (
+                            outcomes.get(rec.outcome, 0) + 1)
+                    artifact = {
+                        "requests": len(records), "outcomes": outcomes,
+                        "mode": ("cluster_batched_split" if rt is not None
+                                 else "cluster_batched"),
+                        "cluster": rep,
+                        "records": [r.as_dict() for r in records]}
+                    with open(out("cluster_report.json"), "w") as f:
+                        json.dump(artifact, f, indent=1, default=float)
+                    print(json.dumps({
+                        "requests": len(records), "outcomes": outcomes,
+                        "mode": artifact["mode"],
+                        "replicas": len(rep["replicas"]),
+                        "placements": rep["totals"],
+                        "artifact": out("cluster_report.json")},
+                        default=float))
+                    if cluster.pending:
+                        raise SystemExit(
+                            f"cluster drain left {cluster.pending} accepted "
+                            f"request(s) unterminated — the router lost "
+                            f"work: {rep}")
+                    return 0
                 batcher = ContinuousBatcher(cfg, params, bcfg, **split_kw)
                 front = ServeFront(cfg, params, config=front_cfg,
                                    clock=clock, batcher=batcher)

@@ -1,5 +1,9 @@
 from .batching import (BatchingConfig, ContinuousBatcher,
                        batched_step_cache_size)
+from .cluster import (AutoscalerConfig, ClusterConfig, ClusterConfigError,
+                      ClusterFront, Replica, ReplicaLostError, RespawnConfig,
+                      SimReplicaConfig, SimReplicaFront, drive_cluster,
+                      sim_reference_tokens)
 from .decode import (decode_step_cache_size, generate, generate_split,
                      resume_split)
 from .frontend import Request, RequestRecord, ServeFront, ServeFrontConfig
@@ -11,7 +15,7 @@ from .overload import (AdmissionConfig, AdmissionController, AdmissionError,
 from .recovery import (CheckpointError, DecodeCheckpoint, DecodeTimeout,
                        LocalRuntime, RecoveryConfig, RecoveryCounters,
                        StageFailure, StageLostError, Watchdog)
-from .soak import SoakConfig, run_soak
+from .soak import ClusterSoakConfig, SoakConfig, run_cluster_soak, run_soak
 
 __all__ = [
     "generate", "generate_split", "resume_split", "decode_step_cache_size",
@@ -26,4 +30,9 @@ __all__ = [
     "ServeFrontConfigError",
     "SoakConfig", "run_soak",
     "BatchingConfig", "ContinuousBatcher", "batched_step_cache_size",
+    "AutoscalerConfig", "ClusterConfig", "ClusterConfigError",
+    "ClusterFront", "Replica", "ReplicaLostError", "RespawnConfig",
+    "SimReplicaConfig", "SimReplicaFront", "drive_cluster",
+    "sim_reference_tokens",
+    "ClusterSoakConfig", "run_cluster_soak",
 ]

@@ -345,6 +345,16 @@ class ContinuousBatcher:
         self._streams.pop(sid, None)
         return toks
 
+    def probe_prefix(self, prompt_ids) -> int:
+        """Router affinity lookup: how many leading tokens of this prompt the
+        paged pool's radix index already holds (a pure dry-run — no stats, no
+        refcounts). 0 when prefix sharing is off, so a cluster router can
+        probe any replica uniformly."""
+        if self.pool.prefix is None:
+            return 0
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        return int(self.pool.probe_prefix(prompt)["tokens"])
+
     def discard(self, sid: int) -> None:
         """Drop a stream in any state and forget its result — the orphan
         hatch: an aborted drain would otherwise leave its inflight streams

@@ -58,7 +58,7 @@ import jax.numpy as jnp
 
 from ..obs import context as obs_context
 from ..obs.flight import flight_dump_for, get_flight_recorder
-from ..obs.metrics import get_registry, record_prefix_stats
+from ..obs.metrics import Histogram, get_registry, record_prefix_stats
 from ..obs.server import ObsServer
 from ..obs.tracing import span as obs_span
 from ..utils.clock import MONOTONIC, Clock
@@ -173,6 +173,12 @@ class ServeFrontConfig:
     checkpoint_every: int = 0
     local_fallback: bool = True
     replan_on_stage_loss: bool = True
+    #: keep every RequestRecord in ``records`` (the post-hoc audit surface).
+    #: False drops terminal records after they are returned from drain and
+    #: folded into the running aggregates — a 10⁶-request soak stays
+    #: memory-flat while ``report()`` stays exact on counts and ~exact on
+    #: percentiles (log-bucketed histograms)
+    record_history: bool = True
 
     def __post_init__(self):
         if (isinstance(self.capacity_round, bool)
@@ -211,7 +217,8 @@ def _round_up(n: int, quantum: int) -> int:
     return ((n + quantum - 1) // quantum) * quantum
 
 
-@guarded_by("_submit_lock", fields=["_seq", "_queue", "_backlog_s"])
+@guarded_by("_submit_lock", fields=["_seq", "_queue", "_backlog_s",
+                                    "_inflight_rids", "_agg", "records"])
 class ServeFront:
     """The serving front. One instance owns the queue, the controllers, the
     breakers, and (optionally) a split runtime; ``submit`` admits,
@@ -264,6 +271,18 @@ class ServeFront:
         if fl is not None:
             fl.set_context_provider(self._flight_context)
         self.records: list[RequestRecord] = []
+        # running aggregates — the memory-flat twin of `records`: every
+        # terminal record folds in here (under the submit lock) so report()
+        # and health_summary() stay O(1) in served requests even with
+        # record_history=False. Histograms self-lock, so they fold outside.
+        self._agg: dict = {"requests": 0, "finished": 0, "tokens_out": 0,
+                           "met": 0, "with_deadline": 0,
+                           "outcomes": {}, "reasons": {}}
+        self._ttft_hist = Histogram("serve_ttft_s", lo=1e-6, hi=1e4,
+                                    n_buckets=400)
+        self._latency_hist = Histogram("serve_latency_s", lo=1e-6, hi=1e4,
+                                       n_buckets=400)
+        self._inflight_rids: set = set()
         self.failovers = 0
         self._plans: dict = {}      # (batch, capacity) -> call count
         self._rt = None
@@ -344,6 +363,23 @@ class ServeFront:
     def queue_depth(self) -> int:
         return len(self._queue)
 
+    def probe_prefix(self, prompt_ids) -> int:
+        """Affinity lookup for a cluster router: leading tokens of this
+        prompt the front's paged pool already holds (0 without a
+        prefix-enabled batcher). Pure dry-run — probing N replicas does not
+        skew any replica's hit/miss stats."""
+        if self.batcher is None:
+            return 0
+        return self.batcher.probe_prefix(prompt_ids)
+
+    def load_fraction(self) -> float:
+        """Scalar load pressure in [0, 1]: queue fullness against the
+        admission bound, or the brownout ladder position — whichever is
+        higher. The cluster autoscaler's per-replica input."""
+        depth = len(self._queue) / self.admission.cfg.max_queue_depth
+        level = self.brownout.level / max(1, self.brownout.cfg.max_level)
+        return float(min(1.0, max(depth, level)))
+
     # -- submit ------------------------------------------------------------
 
     def submit(self, req: Request) -> int:
@@ -352,6 +388,16 @@ class ServeFront:
         the admission work runs inside a ``serve.submit`` span bound to the
         request's trace context (so every nested span/metric carries the
         request id)."""
+        rid, _ = self.submit_ex(req)
+        return rid
+
+    def submit_ex(self, req: Request) -> tuple:
+        """:meth:`submit` plus the submit-time refusal in-band: returns
+        ``(rid, record)`` where ``record`` is the terminal
+        :class:`RequestRecord` when the request was rejected or shed at
+        admission, or None when it was queued. A cluster router needs the
+        refusal as a return value — fishing it out of ``records`` is racy
+        and impossible under ``record_history=False``."""
         now = self.clock()
         with self._submit_lock:
             self._seq += 1
@@ -363,9 +409,10 @@ class ServeFront:
                             max_new_tokens=int(req.max_new_tokens))
         with obs_context.bind(rid=f"r{rid}"):
             with obs_span("serve.submit", priority=int(req.priority)):
-                return self._submit_impl(rid, req, now)
+                return rid, self._submit_impl(rid, req, now)
 
-    def _submit_impl(self, rid: int, req: Request, now: float) -> int:
+    def _submit_impl(self, rid: int, req: Request,
+                     now: float) -> Optional[RequestRecord]:
         depth = len(self._queue)
         self.brownout.observe(depth / self.admission.cfg.max_queue_depth)
         prompt = jnp.asarray(req.prompt_ids)
@@ -380,14 +427,12 @@ class ServeFront:
             requested = min(requested, self.config.max_new_tokens_cap)
         granted = self.brownout.token_cap(requested)
         if self.brownout.should_shed(req.priority):
-            self._finish(rid, req, b, s, SHED, "brownout_shed", now)
-            return rid
+            return self._finish(rid, req, b, s, SHED, "brownout_shed", now)
         try:
             self.admission.admit(s, granted, depth, req.deadline_s,
                                  backlog_s=self._backlog_s)
         except AdmissionError as e:
-            self._finish(rid, req, b, s, REJECTED, e.reason, now)
-            return rid
+            return self._finish(rid, req, b, s, REJECTED, e.reason, now)
         est = self.admission.estimate_s(s, granted)
         pend = _Pending(rid=rid, req=req, prompt=prompt, granted=granted,
                         est_s=est, submitted_at=now)
@@ -397,7 +442,7 @@ class ServeFront:
             heapq.heappush(self._queue,
                            (-req.priority, deadline_key, rid, pend))
             self._backlog_s += est
-        return rid
+        return None
 
     # -- drain -------------------------------------------------------------
 
@@ -410,7 +455,26 @@ class ServeFront:
                 return None
             _, _, _, pend = heapq.heappop(self._queue)
             self._backlog_s = max(0.0, self._backlog_s - pend.est_s)
+            self._inflight_rids.add(pend.rid)
             return pend
+
+    def drain_pending(self) -> list:
+        """Pop EVERY queued (not yet executing) request and hand it back as
+        ``[(rid, Request)]`` without recording a terminal outcome — the
+        replica-drain hatch: a cluster router re-admits the work on a
+        surviving replica under the same seed, so the tokens stay identical
+        and nothing is lost or double-counted here."""
+        out: list = []
+        with self._submit_lock:
+            while self._queue:
+                _, _, _, pend = heapq.heappop(self._queue)
+                out.append((pend.rid, pend.req))
+            self._backlog_s = 0.0
+        fl = get_flight_recorder()
+        if fl is not None:
+            for rid, _ in out:
+                fl.end_request(f"r{rid}")
+        return out
 
     def drain(self, max_requests: Optional[int] = None) -> list:
         """Execute queued requests in (priority, deadline) order; returns
@@ -791,7 +855,29 @@ class ServeFront:
             plan=plan, brownout_level=self.brownout.level,
             retries_charged=retries_charged, jit_misses=jit_misses,
             tokens=tokens, recovery=recovery)
-        self.records.append(rec)
+        # histograms self-lock; folding them outside keeps the submit lock
+        # to pure dict/scalar updates
+        if outcome in (COMPLETED, FAILED_OVER):
+            if ttft_s is not None:
+                self._ttft_hist.observe(ttft_s)
+            if latency_s is not None:
+                self._latency_hist.observe(latency_s)
+        with self._submit_lock:
+            agg = self._agg
+            agg["requests"] += 1
+            agg["outcomes"][outcome] = agg["outcomes"].get(outcome, 0) + 1
+            if reason:
+                agg["reasons"][reason] = agg["reasons"].get(reason, 0) + 1
+            if outcome in (COMPLETED, FAILED_OVER):
+                agg["finished"] += 1
+                if granted_tokens is not None:
+                    agg["tokens_out"] += batch * granted_tokens
+                if deadline_met is not None:
+                    agg["with_deadline"] += 1
+                    agg["met"] += int(deadline_met)
+            if self.config.record_history:
+                self.records.append(rec)
+            self._inflight_rids.discard(rid)
         fl = get_flight_recorder()
         if fl is not None:
             fl.end_request(f"r{rid}")
@@ -816,48 +902,37 @@ class ServeFront:
         return rec
 
     def report(self) -> dict:
-        """Aggregate view over every record so far: outcome/reason counts,
-        SLO attainment, TTFT/latency percentiles, controller summaries,
-        breaker states, (batch, capacity) plan usage."""
-        outcomes: dict = {}
-        reasons: dict = {}
-        ttfts, lats = [], []
-        finished = met = with_deadline = 0
-        tokens_out = 0
-        for r in self.records:
-            outcomes[r.outcome] = outcomes.get(r.outcome, 0) + 1
-            if r.reason:
-                reasons[r.reason] = reasons.get(r.reason, 0) + 1
-            if r.outcome in (COMPLETED, FAILED_OVER):
-                finished += 1
-                if r.ttft_s is not None:
-                    ttfts.append(r.ttft_s)
-                if r.latency_s is not None:
-                    lats.append(r.latency_s)
-                if r.granted_tokens is not None:
-                    tokens_out += r.batch * r.granted_tokens
-                if r.deadline_met is not None:
-                    with_deadline += 1
-                    met += int(r.deadline_met)
+        """Aggregate view over every terminal record so far: outcome/reason
+        counts, SLO attainment, TTFT/latency percentiles, controller
+        summaries, breaker states, (batch, capacity) plan usage. Computed
+        from the running aggregates — O(1) in requests served, so a
+        10⁶-request soak can call it freely and ``record_history=False``
+        loses nothing but the raw record list. Percentiles come from
+        log-bucketed histograms (exact to one bucket's relative width,
+        ~2.3% at the default 400-bucket density)."""
 
-        def pct(xs):
-            if not xs:
+        def pct(hist):
+            if hist.count == 0:
                 return None
-            a = np.asarray(xs, np.float64)
-            return {"p50": float(np.percentile(a, 50)),
-                    "p95": float(np.percentile(a, 95)),
-                    "p99": float(np.percentile(a, 99))}
+            return {"p50": float(hist.quantile(0.50)),
+                    "p95": float(hist.quantile(0.95)),
+                    "p99": float(hist.quantile(0.99))}
 
+        with self._submit_lock:
+            agg = {**self._agg, "outcomes": dict(self._agg["outcomes"]),
+                   "reasons": dict(self._agg["reasons"])}
+            depth = len(self._queue)
         return {
-            "requests": len(self.records),
-            "finished": finished,
-            "tokens_out": tokens_out,
-            "outcomes": outcomes,
-            "reasons": reasons,
-            "slo_attainment": (met / with_deadline) if with_deadline else None,
-            "ttft_s": pct(ttfts),
-            "latency_s": pct(lats),
-            "queue_depth": len(self._queue),
+            "requests": agg["requests"],
+            "finished": agg["finished"],
+            "tokens_out": agg["tokens_out"],
+            "outcomes": agg["outcomes"],
+            "reasons": agg["reasons"],
+            "slo_attainment": ((agg["met"] / agg["with_deadline"])
+                               if agg["with_deadline"] else None),
+            "ttft_s": pct(self._ttft_hist),
+            "latency_s": pct(self._latency_hist),
+            "queue_depth": depth,
             "failovers": self.failovers,
             "admission": self.admission.summary(),
             "retry_budget": self.budget.summary(),
@@ -892,23 +967,35 @@ class ServeFront:
     def health_summary(self) -> dict:
         """The ``/healthz`` body: degraded whenever any breaker left the
         closed state or brownout is active, ok otherwise. Read-only — no
-        breaker probes, no controller side effects."""
-        breakers = {n: b.summary()
-                    for n, b in sorted(self._breakers.items())}
-        open_names = [n for n, s in breakers.items()
-                      if s.get("state") != "closed"]
-        status = ("degraded" if open_names or self.brownout.level
-                  else "ok")
-        health: dict = {
-            "status": status,
-            "open_breakers": open_names,
-            "brownout_level": self.brownout.level,
-            "queue_depth": len(self._queue),
-            "records": len(self.records),
-            "failovers": self.failovers,
-        }
-        if self.link_health is not None:
-            health["link_health"] = self.link_health.summary()
+        breaker probes, no controller side effects.
+
+        The whole body is ONE consistent snapshot taken under the submit
+        lock: a cluster router polls N replicas mid-transition, and without
+        the lock it could read the queue after a pop but the record count
+        before the finish (a request that exists nowhere), or a brownout
+        level from a different instant than the queue depth it supposedly
+        explains. Lock order is submit lock → controller locks; no
+        controller ever calls back into the front, so the order is acyclic
+        (threadlint EG102). ``inflight`` counts popped-but-unfinished
+        requests so ``queue_depth + inflight + records`` always accounts for
+        every admitted request."""
+        with self._submit_lock:
+            breakers = {n: b.summary()
+                        for n, b in sorted(self._breakers.items())}
+            open_names = [n for n, s in breakers.items()
+                          if s.get("state") != "closed"]
+            level = self.brownout.level
+            health: dict = {
+                "status": "degraded" if open_names or level else "ok",
+                "open_breakers": open_names,
+                "brownout_level": level,
+                "queue_depth": len(self._queue),
+                "inflight": len(self._inflight_rids),
+                "records": self._agg["requests"],
+                "failovers": self.failovers,
+            }
+            if self.link_health is not None:
+                health["link_health"] = self.link_health.summary()
         return health
 
     def start_obs_server(self, port: int = 0) -> int:

@@ -485,6 +485,16 @@ class CircuitBreaker:
         self._failures = 0
         self.opens += 1
 
+    def reset(self) -> None:
+        """Back to fresh-closed (a respawned replica starts with a clean
+        failure record); ``opens``/``total_failures`` survive as lifetime
+        counters so the reset is visible in the summary, not erased."""
+        with self._lock:
+            self._state = CLOSED
+            self._failures = 0
+            self._opened_at = None
+            self._probes = 0
+
     def observe_burn(self, burn_rate: float) -> None:
         """Fold a LinkHealth burn-rate reading into the failure signal."""
         if burn_rate >= self.cfg.burn_threshold:

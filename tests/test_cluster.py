@@ -1,0 +1,442 @@
+"""Cluster-scale replica router (PR 17): prefix-affinity placement,
+per-replica failure isolation, and the chaos soak.
+
+Correctness anchors, in order of importance:
+
+- a mid-soak replica kill loses ZERO accepted requests: queued work
+  re-admits from scratch and mid-flight work resumes from its
+  DecodeCheckpoint, and every completed request is TOKEN-IDENTICAL to the
+  fault-free single-replica reference — at greedy AND at temperature > 0
+  via its recorded seed;
+- placement is deterministic: longest-shared-prefix affinity above the
+  threshold, least-loaded fallback, (queue_depth, id) tiebreak;
+- a dead replica respawns from a clean plan after exponential backoff with
+  seeded jitter on the injected clock, and rejoins the rotation only after
+  its half-open probe requests complete (a failed probe re-kills it);
+- exactly ONE flight-recorder post-mortem per induced failure;
+- the simulated autoscaler obeys min-dwell hysteresis — pressure swings
+  inside the dwell window cannot flap the fleet;
+- fleet capacity scales with N: the discrete-event replicas serve in
+  parallel on the shared virtual timeline.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from edgellm_tpu.serve import Request
+from edgellm_tpu.serve.cluster import (AutoscalerConfig, ClusterConfig,
+                                       ClusterConfigError, ClusterFront,
+                                       RespawnConfig, SimReplicaConfig,
+                                       SimReplicaFront, drive_cluster,
+                                       sim_reference_tokens)
+from edgellm_tpu.serve.soak import ClusterSoakConfig, run_cluster_soak
+from edgellm_tpu.utils.clock import FakeClock
+
+
+def _prompt(seed, n=16, vocab=50_000):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, vocab, size=n).astype(np.int32)
+
+
+def _fleet(n=2, clock=None, sim_cfg=None, **cfg_kw):
+    clock = clock if clock is not None else FakeClock()
+    scfg = sim_cfg if sim_cfg is not None else SimReplicaConfig()
+    fronts = {}
+
+    def factory(rid, gen):
+        f = SimReplicaFront(scfg, clock=clock, replica_id=rid)
+        fronts[(rid, gen)] = f
+        return f
+
+    cluster = ClusterFront(factory, ClusterConfig(num_replicas=n, **cfg_kw),
+                           clock=clock)
+    return cluster, clock, fronts
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+def test_config_validation_rejects_bad_fields():
+    with pytest.raises(ClusterConfigError):
+        ClusterConfig(num_replicas=0)
+    with pytest.raises(ClusterConfigError):
+        ClusterConfig(max_readmissions=-1)
+    with pytest.raises(ClusterConfigError):
+        RespawnConfig(backoff_factor=0.5)
+    with pytest.raises(ClusterConfigError):
+        RespawnConfig(half_open_probes=0)
+    with pytest.raises(ClusterConfigError):
+        AutoscalerConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ClusterConfigError):
+        ClusterConfig(respawn={"backoff_base_s": 1.0})  # dict, not config
+
+
+# ---------------------------------------------------------------------------
+# placement: affinity, least-loaded fallback, deterministic tiebreak
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_routes_to_the_warm_replica():
+    cluster, clock, _ = _fleet(2, min_affinity_tokens=4)
+    shared = _prompt(7, n=16)
+    first = cluster.submit(Request(prompt_ids=shared, max_new_tokens=4))
+    recs = drive_cluster(cluster, clock)
+    assert [r.request_id for r in recs] == [first]
+    warm_replica = recs[0].plan["replica"]
+    # same 16-token prefix + fresh suffix: affinity must beat least-loaded
+    # even though both replicas are idle
+    follow = np.concatenate([shared, _prompt(8, n=8)]).astype(np.int32)
+    cluster.submit(Request(prompt_ids=follow, max_new_tokens=4))
+    recs = drive_cluster(cluster, clock)
+    assert recs[0].plan["replica"] == warm_replica
+    assert cluster.totals["affinity"] == 1
+
+
+def test_least_loaded_fallback_with_deterministic_tiebreak():
+    cluster, clock, _ = _fleet(3, min_affinity_tokens=4)
+    # all idle, nothing indexed: equal depth -> lowest id, then the queue
+    # depths break the next ties
+    crids = [cluster.submit(Request(prompt_ids=_prompt(i), max_new_tokens=4))
+             for i in range(3)]
+    placed = {crid: cluster._placements[crid].replica_id for crid in crids}
+    assert [placed[c] for c in crids] == [0, 1, 2]
+    assert cluster.totals["least_loaded"] == 3
+    recs = drive_cluster(cluster, clock)
+    assert len(recs) == 3
+
+
+def test_short_shared_prefix_does_not_trigger_affinity():
+    cluster, clock, _ = _fleet(2, min_affinity_tokens=8,
+                               sim_cfg=SimReplicaConfig(prefix_block=4))
+    p = _prompt(3, n=16)
+    cluster.submit(Request(prompt_ids=p, max_new_tokens=4))
+    drive_cluster(cluster, clock)
+    # only the first 4 tokens shared (< min_affinity_tokens=8)
+    follow = np.concatenate([p[:4], _prompt(9, n=12)]).astype(np.int32)
+    cluster.submit(Request(prompt_ids=follow, max_new_tokens=4))
+    drive_cluster(cluster, clock)
+    assert cluster.totals["affinity"] == 0
+    assert cluster.totals["least_loaded"] == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet capacity scales with N (the DES property the goodput gate measures)
+# ---------------------------------------------------------------------------
+
+
+def test_two_replicas_finish_in_about_half_the_virtual_time():
+    def span(n_replicas):
+        cluster, clock, _ = _fleet(n_replicas)
+        t0 = clock.now
+        for i in range(8):
+            cluster.submit(Request(prompt_ids=_prompt(i), max_new_tokens=16))
+        recs = drive_cluster(cluster, clock)
+        assert len(recs) == 8
+        return clock.now - t0
+
+    one, two = span(1), span(2)
+    assert two < 0.75 * one, (one, two)
+
+
+# ---------------------------------------------------------------------------
+# replica kill: zero accepted loss, token identity at greedy AND sampled
+# ---------------------------------------------------------------------------
+
+
+def _kill_workload(n=12):
+    """Half greedy, half sampled with a recorded per-request seed."""
+    reqs = []
+    for i in range(n):
+        sampled = i % 2 == 1
+        reqs.append(Request(prompt_ids=_prompt(100 + i),
+                            max_new_tokens=16,
+                            temperature=0.7 if sampled else 0.0,
+                            rng_seed=1000 + i if sampled else 0))
+    return reqs
+
+
+def _reference_tokens(req):
+    """The fault-free single-replica reference: a 1-replica fleet with no
+    chaos serves the same request; its tokens are the identity target."""
+    cluster, clock, _ = _fleet(1)
+    cluster.submit(req)
+    recs = drive_cluster(cluster, clock)
+    assert len(recs) == 1 and recs[0].outcome == "completed"
+    return np.asarray(recs[0].tokens).reshape(-1)
+
+
+def test_replica_kill_token_identity_greedy_and_sampled(tmp_path):
+    reqs = _kill_workload(12)
+    cluster, clock, _ = _fleet(
+        2, checkpoint_dir=str(tmp_path / "ckpt"),
+        flight_dir=str(tmp_path / "flight"))
+    crid_to_req = {cluster.submit(r): r for r in reqs}
+    # run partway so replica 0 is mid-decode, then kill it
+    partial = cluster.drain(max_requests=3)
+    nxt = cluster.next_event_s()
+    while len(partial) < 3:
+        if nxt is not None and nxt > clock.now:
+            clock.set_time(nxt)
+        partial.extend(cluster.drain(max_requests=3 - len(partial)))
+        nxt = cluster.next_event_s()
+    cluster.kill_replica(0, "chaos")
+    records = partial + drive_cluster(cluster, clock)
+    # zero accepted loss: every submitted request reached exactly one
+    # terminal record, all completed
+    assert sorted(r.request_id for r in records) == sorted(crid_to_req)
+    assert all(r.outcome == "completed" for r in records)
+    # token identity vs the fault-free single-replica reference, greedy and
+    # sampled alike (the recorded seed pins the sampled stream)
+    for rec in records:
+        ref = _reference_tokens(crid_to_req[rec.request_id])
+        assert np.array_equal(np.asarray(rec.tokens).reshape(-1), ref), \
+            f"request {rec.request_id} diverged after the kill"
+    assert cluster.totals["readmitted"] > 0
+    assert len(cluster.kills) == 1
+
+
+def test_mid_flight_checkpoint_resume_has_zero_recompute():
+    cluster, clock, fronts = _fleet(2)
+    req = Request(prompt_ids=_prompt(42), max_new_tokens=16)
+    cluster.submit(req)
+    # advance through prefill + one decode chunk so tokens exist mid-flight
+    for _ in range(8):
+        if not cluster.drain():
+            nxt = cluster.next_event_s()
+            if nxt is None:
+                break
+            clock.set_time(nxt)
+        if fronts[(0, 0)]._current is not None \
+                and fronts[(0, 0)]._current.tokens:
+            break
+    assert fronts[(0, 0)]._current is not None
+    done_before = len(fronts[(0, 0)]._current.tokens)
+    assert 0 < done_before < 16
+    cluster.kill_replica(0, "chaos")
+    recs = drive_cluster(cluster, clock)
+    assert len(recs) == 1 and recs[0].outcome == "completed"
+    assert np.array_equal(
+        np.asarray(recs[0].tokens).reshape(-1),
+        sim_reference_tokens(np.asarray(req.prompt_ids), 16)[0])
+    # the checkpointed chain resumed where it stopped — nothing recomputed
+    assert cluster.totals["recompute_tokens"] == 0
+    assert recs[0].recovery["readmissions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# respawn: exponential backoff + jitter, half-open probes
+# ---------------------------------------------------------------------------
+
+
+def test_respawn_backoff_grows_and_half_open_probes_gate_rejoin():
+    rs = RespawnConfig(backoff_base_s=1.0, backoff_factor=2.0,
+                       backoff_max_s=30.0, jitter_frac=0.25,
+                       half_open_probes=2)
+    cluster, clock, fronts = _fleet(2, respawn=rs)
+    cluster.kill_replica(0, "chaos")
+    r0 = cluster.replicas[0]
+    first_backoff = r0.respawn_at - clock.now
+    assert 1.0 <= first_backoff <= 1.0 * 1.25
+    # not due yet: the replica stays dead
+    clock.advance(first_backoff / 2)
+    cluster.submit(Request(prompt_ids=_prompt(1), max_new_tokens=4))
+    assert r0.state == "dead"
+    clock.advance(first_backoff)  # past respawn_at
+    cluster.submit(Request(prompt_ids=_prompt(2), max_new_tokens=4))
+    assert r0.state == "probing"
+    assert r0.generation == 1  # clean plan: a NEW front from the factory
+    assert (0, 1) in fronts
+    # probing replicas take placements first (they need live traffic)
+    assert cluster.totals["probe"] >= 1
+    cluster.submit(Request(prompt_ids=_prompt(3), max_new_tokens=4))
+    recs = drive_cluster(cluster, clock)
+    assert all(r.outcome == "completed" for r in recs)
+    assert r0.state == "live"          # both probes completed -> rejoin
+    assert r0.backoff_attempt == 0     # healthy rejoin resets the ladder
+    # a second kill backs off from the base again after the reset
+    cluster.kill_replica(0, "chaos")
+    second_backoff = r0.respawn_at - clock.now
+    assert 1.0 <= second_backoff <= 1.0 * 1.25
+
+
+def test_repeated_kills_back_off_exponentially():
+    rs = RespawnConfig(backoff_base_s=1.0, backoff_factor=2.0,
+                       backoff_max_s=30.0, jitter_frac=0.0,
+                       half_open_probes=1)
+    cluster, clock, _ = _fleet(2, respawn=rs)
+    r0 = cluster.replicas[0]
+    backoffs = []
+    for _ in range(3):
+        cluster.kill_replica(0, "chaos")
+        backoffs.append(r0.respawn_at - clock.now)
+        clock.set_time(r0.respawn_at)
+        cluster._tick()            # respawn fires; replica goes probing
+        assert r0.state == "probing"
+        r0.state = "live"          # skip the probe phase for this ladder test
+    assert backoffs == [1.0, 2.0, 4.0]
+
+
+def test_failed_half_open_probe_rekills_the_replica():
+    rs = RespawnConfig(backoff_base_s=1.0, backoff_factor=2.0,
+                       jitter_frac=0.0, half_open_probes=1)
+    cluster, clock, fronts = _fleet(2, respawn=rs)
+    cluster.kill_replica(0, "chaos")
+    clock.set_time(cluster.replicas[0].respawn_at)
+    cluster._tick()
+    assert cluster.replicas[0].state == "probing"
+    # the probe request fails replica-fatally on the respawned front
+    fronts[(0, 1)].inject_fault("stage_lost:0")
+    cluster.submit(Request(prompt_ids=_prompt(5), max_new_tokens=4))
+    recs = drive_cluster(cluster, clock)
+    # the probe request itself was re-admitted and completed elsewhere
+    assert all(r.outcome == "completed" for r in recs)
+    assert cluster.replicas[0].state in ("dead", "probing")
+    assert len(cluster.kills) >= 2
+    assert cluster.kills[1]["reason"] == "probe_failed"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: exactly one post-mortem per induced failure
+# ---------------------------------------------------------------------------
+
+
+def test_exactly_one_flight_dump_per_kill(tmp_path):
+    cluster, clock, _ = _fleet(3, flight_dir=str(tmp_path))
+    for i in range(6):
+        cluster.submit(Request(prompt_ids=_prompt(i), max_new_tokens=8))
+    cluster.kill_replica(0, "chaos")
+    cluster.kill_replica(1, "chaos")
+    drive_cluster(cluster, clock)
+    dumps = cluster.flight_dumps()
+    assert len(dumps) == 2 == len(cluster.kills)
+    assert all(os.path.exists(d) for d in dumps)
+
+
+# ---------------------------------------------------------------------------
+# no live replica: typed refusal, accepted work parks instead of dropping
+# ---------------------------------------------------------------------------
+
+
+def test_no_live_replica_refuses_new_and_parks_accepted():
+    rs = RespawnConfig(backoff_base_s=100.0, jitter_frac=0.0)
+    cluster, clock, _ = _fleet(2, respawn=rs)
+    accepted = cluster.submit(Request(prompt_ids=_prompt(1),
+                                      max_new_tokens=8))
+    cluster.kill_replica(0, "chaos")
+    cluster.kill_replica(1, "chaos")
+    refused = cluster.submit(Request(prompt_ids=_prompt(2), max_new_tokens=8))
+    recs = cluster.drain()
+    assert [r.request_id for r in recs] == [refused]
+    assert recs[0].outcome == "rejected"
+    assert recs[0].reason == "no_live_replica"
+    # the accepted request parked — and completes once a respawn lands
+    assert cluster.pending == 1
+    clock.advance(200.0)
+    recs = drive_cluster(cluster, clock)
+    assert [r.request_id for r in recs] == [accepted]
+    assert recs[0].outcome == "completed"
+
+
+# ---------------------------------------------------------------------------
+# autoscaler: pressure-driven with min-dwell hysteresis
+# ---------------------------------------------------------------------------
+
+
+def test_autoscaler_scales_up_and_respects_min_dwell():
+    asc = AutoscalerConfig(enabled=True, min_replicas=2, max_replicas=4,
+                           scale_up_pressure=0.5, scale_down_pressure=0.05,
+                           min_dwell_s=30.0)
+    sim = SimReplicaConfig(max_queue_depth=4)
+    cluster, clock, _ = _fleet(2, autoscaler=asc, sim_cfg=sim)
+    for i in range(8):   # saturate both queues -> pressure 1.0
+        cluster.submit(Request(prompt_ids=_prompt(i), max_new_tokens=4))
+    # the dwell clock starts at construction: saturation inside the first
+    # window must NOT scale
+    assert not cluster.autoscale_events
+    assert len(cluster.replicas) == 2
+    clock.advance(asc.min_dwell_s + 1.0)
+    cluster.submit(Request(prompt_ids=_prompt(99), max_new_tokens=4))
+    ups = [e for e in cluster.autoscale_events if e["direction"] == "up"]
+    assert len(ups) == 1, "dwell must allow exactly one scale-up per window"
+    assert len(cluster.replicas) == 3
+    # still saturated inside the NEW dwell window: no flapping
+    cluster.submit(Request(prompt_ids=_prompt(100), max_new_tokens=4))
+    assert len(cluster.replicas) == 3
+    clock.advance(asc.min_dwell_s + 1.0)
+    cluster.submit(Request(prompt_ids=_prompt(101), max_new_tokens=4))
+    assert len(cluster.replicas) == 4
+    recs = drive_cluster(cluster, clock)
+    assert all(r.outcome == "completed" for r in recs)
+    # fleet idle: the next dwell window allows exactly one scale-down
+    clock.advance(asc.min_dwell_s + 1.0)
+    cluster._tick()
+    downs = [e for e in cluster.autoscale_events if e["direction"] == "down"]
+    assert len(downs) == 1
+    assert len(cluster.replicas) == 3
+
+
+# ---------------------------------------------------------------------------
+# cluster chaos soak (small-n shape of the million-request run)
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_soak_chaos_identity_and_zero_loss(tmp_path):
+    soak = ClusterSoakConfig(
+        n_requests=400, arrival_rate=60.0, seed=3,
+        prompt_len=16, shared_prefix_len=8, num_prefix_groups=8,
+        max_new_tokens=16, deadline_s=120.0,
+        sampled_frac=0.5, sample_temperature=0.7,
+        kills=((0.3, 0), (0.55, 1)),
+        burst_start_frac=0.4, burst_end_frac=0.6, burst_corrupt_rate=0.05)
+    clock = FakeClock()
+
+    def factory(rid, gen):
+        return SimReplicaFront(SimReplicaConfig(), clock=clock,
+                               replica_id=rid)
+
+    cluster = ClusterFront(
+        factory,
+        ClusterConfig(num_replicas=3,
+                      flight_dir=str(tmp_path / "flight"),
+                      checkpoint_dir=str(tmp_path / "ckpt"),
+                      respawn=RespawnConfig(backoff_base_s=0.2,
+                                            jitter_seed=1)),
+        clock=clock)
+    art = run_cluster_soak(cluster, soak, clock=clock)
+    # zero accepted loss: every request terminal, exactly once
+    assert sum(art["outcomes"].values()) == soak.n_requests
+    # every completed request replayed token-identical to the fault-free
+    # reference — through two kills and a corruption burst
+    ti = art["token_identity"]
+    assert ti["ok"], ti
+    assert ti["checked"] == art["outcomes"]["completed"] > 0
+    # the burst produced terminal corruption failures, the kills produced
+    # readmissions, and each induced kill dumped exactly one post-mortem
+    assert art["reasons"].get("substituted_payload", 0) > 0
+    assert art["readmitted"] > 0
+    assert len(art["kills"]) == 2
+    assert len(art["flight_dumps"]) == 2
+    assert all(ev["recovery_s"] is not None for ev in art["kills"])
+    assert art["respawns"] == 2
+    # goodput series exists for the outage-window gate
+    assert art["goodput_buckets"]["tokens"]
+
+
+def test_cluster_soak_requires_fake_clock():
+    clock = FakeClock()
+    cluster, _, _ = _fleet(2, clock=clock)
+    with pytest.raises(TypeError):
+        run_cluster_soak(cluster, ClusterSoakConfig(n_requests=1),
+                         clock=None)
+
+
+def test_soak_config_validation():
+    with pytest.raises(ValueError):
+        ClusterSoakConfig(shared_prefix_len=20, prompt_len=16)
+    with pytest.raises(ValueError):
+        ClusterSoakConfig(kills=((1.5, 0),))
+    with pytest.raises(ValueError):
+        ClusterSoakConfig(burst_start_frac=0.6, burst_end_frac=0.4)

@@ -303,3 +303,45 @@ def test_soak_requires_the_fronts_fake_clock(params):
     front = ServeFront(CFG, params, clock=FakeClock())
     with pytest.raises(TypeError):
         run_soak(front, SoakConfig(n_requests=1), clock=None)
+
+
+# ---------------------------------------------------------------------------
+# health_summary: one consistent snapshot under the submit lock
+# ---------------------------------------------------------------------------
+
+
+def test_health_summary_snapshot_consistent_under_all_interleavings(params):
+    """Bounded schedule exploration (threadlint harness): race ``drain``
+    against ``health_summary`` over every interleaving of their submit-lock
+    critical sections. The one submitted request must be in EXACTLY one
+    place per snapshot — queue, inflight, or the record aggregate. A torn
+    (pre-fix, lock-free) summary can read the queue after the pop but the
+    aggregate before the finish and report a request that exists nowhere
+    (sum 0), or both halves (sum 2)."""
+    from edgellm_tpu.lint.schedules import explore, instrument
+
+    def scenario(sched):
+        clk = FakeClock()
+        front = ServeFront(CFG, params, clock=clk)
+        # an already-expired deadline: drain takes the expired_in_queue
+        # path — pure bookkeeping, no device work under the scheduler
+        front.submit(Request(prompt_ids=_prompt(seed=11), max_new_tokens=4,
+                             deadline_s=5.0))
+        clk.advance(30.0)
+        instrument(sched, front, "_submit_lock")
+        snapshots = []
+
+        def verify():
+            for h in snapshots:
+                total = h["queue_depth"] + h["inflight"] + h["records"]
+                assert total == 1, f"torn snapshot: {h}"
+
+        return ([lambda: front.drain(),
+                 lambda: snapshots.append(front.health_summary())], verify)
+
+    outcomes = explore(scenario, max_preemptions=2)
+    assert len(outcomes) > 1          # the bound really explored schedules
+    assert not any(o.deadlocked for o in outcomes), \
+        [o.blocked for o in outcomes if o.deadlocked]
+    assert not any(o.errors for o in outcomes), \
+        [o.errors for o in outcomes if o.errors]
