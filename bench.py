@@ -134,6 +134,25 @@ BENCH_CLUSTER_OUTAGE_S (default 10), BENCH_CLUSTER_REAL (0 skips the
 real-model leg), BENCH_CLUSTER_REAL_REQUESTS (default 12), plus the
 shared BENCH_MODEL / BENCH_DTYPE.
 
+BENCH_DISAGG=1 switches to the disaggregated prefill/decode acceptance
+surface (see ``disagg_main``), two legs in one section. Leg (a), perf: a
+mixed long/short Poisson workload (half greedy, half sampled via recorded
+seeds) is served twice — once by the DisaggServer (dedicated prefill
+workers migrating quantize-at-rest KV pages over the FEC-framed link to
+the pull-admission decode worker) and once by the colocated continuous
+batcher — and every completed request must be TOKEN-IDENTICAL between the
+two; the headline carries disagg vs colocated TTFT and decode tok/s.
+Leg (b), chaos: ``run_disagg_soak`` fires a mid-migration prefill-worker
+kill, a decode-worker kill, and a link-corruption burst into the same
+seeded workload — gates: zero accepted loss, token identity vs the
+fault-free colocated reference, no degrade (the ladder absorbs the
+burst), and at least one page re-driven or recomputed by the kill.
+Knobs: BENCH_DISAGG_REQUESTS (default 16), BENCH_DISAGG_SEED,
+BENCH_DISAGG_LONG (long-prompt length, default 48), BENCH_DISAGG_SHORT
+(default 8), BENCH_DISAGG_TOKENS (default 8), BENCH_DISAGG_CORRUPT
+(burst bitflip rate, default 0.01), plus the shared
+BENCH_MODEL / BENCH_DTYPE.
+
 BENCH_SERVE=1 switches to the continuous-batching workload (see
 ``serve_main``): the SAME seeded Poisson open-loop arrival trace is served
 twice on a virtual clock — once by the paged continuous batcher (streams
@@ -2328,6 +2347,196 @@ def cluster_main():
         raise SystemExit(f"cluster bench gates failed: {failed}")
 
 
+def disagg_main():
+    """BENCH_DISAGG=1: disaggregated prefill/decode acceptance — a mixed
+    long/short Poisson workload served by the DisaggServer vs the colocated
+    batcher (token identity asserted, TTFT + tok/s compared), then the
+    chaos leg: mid-migration prefill-worker kill, decode-worker kill, and a
+    link-corruption burst with zero accepted loss."""
+    import dataclasses
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from edgellm_tpu.codecs.fec import FECConfig
+    from edgellm_tpu.models import PRESETS, init_params
+    from edgellm_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+    from edgellm_tpu.serve.disagg import DisaggConfig, DisaggServer
+    from edgellm_tpu.serve.soak import DisaggSoakConfig, run_disagg_soak
+
+    model_name = os.environ.get("BENCH_MODEL", "qwen2-0.5b")
+    cfg = PRESETS[model_name]
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        os.environ.get("BENCH_DTYPE", "bfloat16")]
+    seed = int(os.environ.get("BENCH_DISAGG_SEED", "0"))
+    n = int(os.environ.get("BENCH_DISAGG_REQUESTS", "16"))
+    long_len = int(os.environ.get("BENCH_DISAGG_LONG", "48"))
+    short_len = int(os.environ.get("BENCH_DISAGG_SHORT", "8"))
+    new_tokens = int(os.environ.get("BENCH_DISAGG_TOKENS", "8"))
+    corrupt = float(os.environ.get("BENCH_DISAGG_CORRUPT", "0.01"))
+    tmpdir = tempfile.mkdtemp(prefix="bench_disagg_")
+
+    params = init_params(cfg, jax.random.key(0), dtype=dtype)
+    page_size = 8
+    pages_per_slot = -(-(long_len + new_tokens) // page_size)
+    max_slots = 4
+    bcfg = BatchingConfig(page_size=page_size, max_slots=max_slots,
+                          num_pages=1 + max_slots * pages_per_slot,
+                          pages_per_slot=pages_per_slot,
+                          kv_codec="int8_per_channel",
+                          compute_dtype=dtype)
+    dcfg = DisaggConfig(num_prefill_workers=2, prefill_batch=2,
+                        fec=FECConfig(enabled=True))
+
+    # one warm disagg run compiles every executable both legs reuse: the
+    # staging workers' prefill plan AND the decode plan (identical to the
+    # colocated batcher's — same geometry, same kv codec), so compile time
+    # never lands inside a timed leg
+    warm = DisaggServer(cfg, params, bcfg, dcfg)
+    warm.submit(np.ones((long_len,), np.int32), 2, temperature=0.7,
+                rng_seed=1)
+    warm.submit(np.ones((short_len,), np.int32), 2)
+    warm.run()
+
+    # -- leg (a): perf — mixed long/short Poisson, disagg vs colocated -----
+
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n):
+        plen = long_len if i % 2 == 0 else short_len
+        sampled = i % 2 == 1
+        reqs.append((rng.integers(1, cfg.vocab_size,
+                                  size=plen).astype(np.int32),
+                     new_tokens,
+                     0.7 if sampled else 0.0,
+                     100 + i if sampled else 0))
+    arrive_steps = rng.poisson(1.0, size=n)
+
+    def first_token_ready(server, sid) -> bool:
+        if sid in server.results:
+            return True
+        if hasattr(server, "handoffs"):      # DisaggServer
+            if sid in server.handoffs:       # token 0 migrated, queued
+                return True
+            dsid = server._to_decode.get(sid)
+            if dsid is None:
+                return False
+            st = server.decode._streams.get(dsid)
+            return bool(st is not None and st.tokens)
+        st = server._streams.get(sid)
+        return bool(st is not None and st.tokens)
+
+    def drive(server) -> dict:
+        sids: list = []
+        ttft: dict = {}
+        t0 = time.perf_counter()
+
+        def scan() -> None:
+            now = time.perf_counter() - t0
+            for i, s in enumerate(sids):
+                if i not in ttft and first_token_ready(server, s):
+                    ttft[i] = now
+
+        for i, (p, mnt, temp, rs) in enumerate(reqs):
+            sids.append(server.submit(p, mnt, temperature=temp,
+                                      rng_seed=rs))
+            for _ in range(int(arrive_steps[i]) + 1):
+                server.step()
+            scan()
+        guard = 0
+        while len(server.results) < n:
+            server.step()
+            scan()
+            guard += 1
+            assert guard < 100_000, "drive(): server stalled"
+        wall = time.perf_counter() - t0
+        results = [np.asarray(server.results[s]).tolist() for s in sids]
+        tokens_out = sum(len(r) for r in results)
+        tt = sorted(ttft.values())
+        return {"wall_s": wall, "tokens_out": tokens_out,
+                "tokens_per_s": tokens_out / max(wall, 1e-9),
+                "ttft_mean_s": float(np.mean(tt)),
+                "ttft_p50_s": float(tt[len(tt) // 2]),
+                "results": results}
+
+    srv = DisaggServer(cfg, params, bcfg, dcfg)
+    disagg = drive(srv)
+    disagg_rep = srv.report()["disagg"]
+    colo = drive(ContinuousBatcher(cfg, params, bcfg))
+    mismatched = [i for i in range(n)
+                  if disagg["results"][i] != colo["results"][i]]
+
+    # -- leg (b): chaos — worker kills + corruption burst, zero loss -------
+
+    chaos_dcfg = DisaggConfig(num_prefill_workers=3, prefill_batch=2,
+                              queue_bound=4, degrade_after=50,
+                              fec=FECConfig(enabled=True))
+    chaos_bcfg = dataclasses.replace(bcfg, checkpoint_dir=tmpdir)
+    chaos_soak = DisaggSoakConfig(
+        n_requests=n, seed=seed + 1, vocab_size=cfg.vocab_size,
+        min_prompt_len=short_len, max_prompt_len=long_len,
+        max_new_tokens=new_tokens, sampled_frac=0.5,
+        sample_temperature=0.7,
+        kills=((0.25, "prefill"), (0.7, "decode")),
+        burst_start_frac=0.4, burst_end_frac=0.6,
+        burst_bitflip_rate=corrupt)
+    chaos_srv = DisaggServer(cfg, params, chaos_bcfg, chaos_dcfg)
+    chaos = run_disagg_soak(
+        chaos_srv, chaos_soak,
+        reference_factory=lambda: ContinuousBatcher(cfg, params, bcfg))
+
+    identity = chaos["token_identity"]
+    gates = {
+        "perf_identity_ok": not mismatched,
+        "perf_not_degraded": not disagg_rep["degraded"],
+        "perf_all_migrated": disagg_rep["migrations"] == n,
+        "chaos_zero_accepted_loss": chaos["accepted_lost"] == 0
+            and chaos["completed"] == n,
+        "chaos_identity_ok": bool(identity["ok"]
+                                  and identity["checked"] == n),
+        "chaos_kills_fired": len(chaos["kills"]) >= len(chaos_soak.kills),
+        "chaos_not_degraded": not chaos["disagg"]["degraded"],
+    }
+    detail = {
+        "model": model_name, "requests": n,
+        "long_len": long_len, "short_len": short_len,
+        "disagg": {k: v for k, v in disagg.items() if k != "results"},
+        "colocated": {k: v for k, v in colo.items() if k != "results"},
+        "mismatched": mismatched,
+        "disagg_report": disagg_rep,
+        "chaos": chaos,
+        "gates": gates,
+    }
+    line = {
+        "metric": (f"disagg vs colocated serve ({n} reqs, "
+                   f"{long_len}/{short_len} mixed prompts, int8 KV pages "
+                   f"over FEC link)"),
+        "value": round(disagg["tokens_per_s"], 2),
+        "unit": "decode tokens/s (disagg)",
+        "vs_baseline": round(disagg["tokens_per_s"]
+                             / max(colo["tokens_per_s"], 1e-9), 4),
+        "ttft_disagg_s": round(disagg["ttft_mean_s"], 4),
+        "ttft_colocated_s": round(colo["ttft_mean_s"], 4),
+        "token_identity_ok": gates["perf_identity_ok"],
+        "migrations": disagg_rep["migrations"],
+        "migrated_pages": disagg_rep["migrated_pages"],
+        "wire_bytes": disagg_rep["wire_bytes"],
+        "chaos_completed": chaos["completed"],
+        "chaos_identity_ok": gates["chaos_identity_ok"],
+        "chaos_kills": len(chaos["kills"]),
+        "chaos_redriven_pages": chaos["disagg"]["redriven_pages"],
+        "chaos_recompute_tokens": chaos["disagg"]["recompute_tokens"],
+        "chaos_link_repaired": chaos["disagg"]["link"]["repaired"],
+        "gates_ok": all(gates.values()),
+    }
+    _emit(line, detail)
+    if not all(gates.values()):
+        failed = sorted(k for k, v in gates.items() if not v)
+        raise SystemExit(f"disagg bench gates failed: {failed}")
+
+
 def _backend_unavailable(exc: BaseException) -> bool:
     """True when the error is an accelerator-backend outage (the tunneled
     TPU plugin failing to come up), not a code bug in the bench."""
@@ -2388,6 +2597,8 @@ def main():
         return _run_section("soak", soak_main)
     if os.environ.get("BENCH_CLUSTER") == "1":
         return _run_section("cluster", cluster_main)
+    if os.environ.get("BENCH_DISAGG") == "1":
+        return _run_section("disagg", disagg_main)
     if os.environ.get("BENCH_SERVE") == "1":
         return _run_section("serve", serve_main)
     if os.environ.get("BENCH_PREFIX") == "1":

@@ -431,6 +431,92 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
         findings.append(_driver_error("paged.quant-fallback-equivalence", e))
 
+    # ---- disaggregated prefill/decode: pure host-side orchestration.
+    # ---- A DisaggServer's decode batcher — after a REAL migration landed
+    # ---- (prefill on a staging worker, pages over the link, resume adopt)
+    # ---- — must feed the byte-identical ragged step graph the pre-disagg
+    # ---- batcher traces: migration moves page DATA, never the GRAPH ------
+    try:
+        from ..serve import disagg as serve_disagg
+
+        dsrv = serve_disagg.DisaggServer(
+            cfg, params, batching.BatchingConfig(
+                page_size=PGS, num_pages=NPG, max_slots=MS,
+                pages_per_slot=PPS),
+            serve_disagg.DisaggConfig(num_prefill_workers=1,
+                                      prefill_batch=1))
+        dsid = dsrv.submit(np.arange(1, 1 + SEQ, dtype=np.int32), 4,
+                           temperature=0.0, rng_seed=0)
+        dsrv.step()  # prefill + migrate + adopt: decode holds migrated pages
+        if dsrv.report()["disagg"]["migrations"] < 1:
+            raise AssertionError("driver bug: no migration happened")
+        dtab, dlens = dsrv.pool.device_tables()
+        dtoks = jnp.zeros((MS,), jnp.int32)
+        ident = check_identity(
+            "disagg.disabled-identity",
+            lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                cfg, p, pk, pv, pt, ln, t),
+            (params, dsrv.pool.pool.k, dsrv.pool.pool.v, dtab, dlens,
+             dtoks),
+            lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                cfg, p, pk, pv, pt, ln, t),
+            (params, ppool.k, ppool.v, ptab, plens, ptoks),
+            what="disagg decode batcher's ragged decode-step graph (with "
+                 "migrated pages live)")
+        (findings.extend(ident) if ident
+         else checked.append("disagg.disabled-identity"))
+        dsrv.run()
+        dsrv.pop_result(dsid)
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("disagg.disabled-identity", e))
+
+    # ---- disagg migration wire bytes: every page transfer's built wire
+    # ---- tree must measure exactly migration_wire_nbytes(payload) — the
+    # ---- sealed form (payload + 8 B sidecar) and the FEC frame (parity
+    # ---- chunks + per-chunk checksum words). A drifting frame layout is a
+    # ---- silent protocol break between prefill and decode builds ---------
+    try:
+        from ..codecs import fec as codecs_fec
+        from ..codecs import wire_format as codecs_wire
+
+        wbat = batching.ContinuousBatcher(
+            cfg, params, batching.BatchingConfig(
+                page_size=PGS, num_pages=NPG, max_slots=MS,
+                pages_per_slot=PPS))
+        wsid = wbat.submit(np.arange(1, 1 + SEQ, dtype=np.int32), 2,
+                           temperature=0.0, rng_seed=0)
+        wst = wbat.prefill_hold(wsid)
+        chunk = wbat.gather_rows(wst.slot, 0, PGS)
+        payload = jax.tree_util.tree_map(jnp.asarray, chunk)
+        sealed = codecs_wire.seal_payload(payload)
+        bad = []
+        measured = codecs_wire.tree_nbytes(sealed)
+        declared = serve_disagg.migration_wire_nbytes(
+            codecs_wire.tree_nbytes(payload), None)
+        if measured != declared:
+            bad.append(f"sealed frame measures {measured} B, "
+                       f"declared {declared} B")
+        fcfg = codecs_fec.FECConfig(enabled=True)
+        fmeasured = codecs_wire.tree_nbytes(
+            codecs_fec.fec_encode(sealed, fcfg))
+        fdeclared = serve_disagg.migration_wire_nbytes(
+            codecs_wire.tree_nbytes(payload), fcfg)
+        if fmeasured != fdeclared:
+            bad.append(f"FEC frame measures {fmeasured} B, "
+                       f"declared {fdeclared} B")
+        wbat.release_handoff(wsid)
+        if bad:
+            findings.append(Finding(
+                layer="graph", rule="GC-identity",
+                where="disagg.migration-wire-bytes", line=0,
+                message="migration wire-byte contract violated: "
+                        + "; ".join(bad)))
+        else:
+            checked.append("disagg.migration-wire-bytes")
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("disagg.migration-wire-bytes", e))
+
+
     # ---- split pipeline: boundary hops over a real 2-stage mesh ---------
     if len(jax.devices()) < 2:
         skipped.append("split/fault contracts: needs >= 2 devices "

@@ -5,8 +5,8 @@ from .transformer import (
     precompute_rope, KVCache, init_cache, prefill, decode_step,
 )
 from .hf_loader import params_from_state_dict, config_from_hf
-from .paged_kv import (OutOfPages, OutOfSlots, PagedKVCache, PagePool,
-                       init_pool, paged_decode_step)
+from .paged_kv import (KVTierMismatchError, OutOfPages, OutOfSlots,
+                       PagedKVCache, PagePool, init_pool, paged_decode_step)
 
 __all__ = [
     "ModelConfig", "PYTHIA_70M", "QWEN2_0_5B", "QWEN2_1_5B", "LLAMA_3_2_1B",
@@ -14,6 +14,6 @@ __all__ = [
     "AttnStats", "forward", "run_layers", "embed", "unembed", "nll_from_logits",
     "init_params", "precompute_rope", "params_from_state_dict", "config_from_hf",
     "KVCache", "init_cache", "prefill", "decode_step",
-    "OutOfPages", "OutOfSlots", "PagedKVCache", "PagePool", "init_pool",
-    "paged_decode_step",
+    "KVTierMismatchError", "OutOfPages", "OutOfSlots", "PagedKVCache",
+    "PagePool", "init_pool", "paged_decode_step",
 ]

@@ -277,6 +277,26 @@ class OutOfSlots(RuntimeError):
     """Every slot of the compiled step shape is occupied."""
 
 
+class KVTierMismatchError(ValueError):
+    """A KV payload at one ``kv_codec`` tier was offered to a pool built at
+    another. Every adoption surface — packed adopts, checkpoint restore,
+    page migration — raises THIS type (never a transcode): silently
+    requantizing or inflating would change page bytes under the bit-exact
+    round-trip promise. ``offered``/``pool`` carry both tier names so
+    callers can rebuild at the right tier."""
+
+    def __init__(self, *, offered: str, pool: str, where: str,
+                 detail: str = ""):
+        self.offered = offered
+        self.pool = pool
+        self.where = where
+        super().__init__(
+            f"KV tier mismatch in {where}: payload is {offered!r}, pool is "
+            f"{pool!r}; rebuild the pool at kv_codec={offered!r} "
+            f"(at-rest transcoding is refused)"
+            + (f" — {detail}" if detail else ""))
+
+
 class PagePool(NamedTuple):
     """Device-side page pool: post-rotary K/V at ``num_kv_heads`` width.
 
@@ -619,6 +639,12 @@ class PagedKVCache:
         self.prefix_counters = {"hits": 0, "misses": 0, "saved_tokens": 0,
                                 "cow_forks": 0, "index_evictions": 0,
                                 "reclaimed_pages": 0}
+        # migration-handoff holds: slots pinned while their pages are in
+        # flight to another pool. free_slot refuses a held slot and defrag
+        # defers wholesale (see hold_slot), so a _flat_indices snapshot
+        # taken under a hold stays valid for the whole transfer.
+        self._slot_holds = np.zeros((max_slots,), np.int32)
+        self.deferred_defrags = 0
 
     # -- geometry ----------------------------------------------------------
 
@@ -723,12 +749,40 @@ class PagedKVCache:
         never reads past a slot's length."""
         if not self.active[slot]:
             raise ValueError(f"slot {slot} is not active")
+        if self._slot_holds[slot]:
+            raise ValueError(
+                f"slot {slot} is held for an in-flight migration "
+                f"({int(self._slot_holds[slot])} hold(s)); release the hold "
+                f"before freeing")
         for p in reversed(self._slot_pages[slot]):
             self._release_ref(p)
         self._slot_pages[slot] = []
         self.page_table[slot] = 0
         self.lengths[slot] = 0
         self.active[slot] = False
+
+    # -- migration-handoff holds -------------------------------------------
+
+    def hold_slot(self, slot: int) -> None:
+        """Pin ``slot`` for an in-flight page handoff: while at least one
+        hold is out, :meth:`free_slot` refuses the slot and :meth:`defrag`
+        defers entirely (returns 0 and bumps ``deferred_defrags``) — nothing
+        may move or recycle the pages a migration's flat-index snapshot
+        references, so the transfer can retry/hedge against stable source
+        bytes. Prefix-index pins are refcounts and survive regardless."""
+        if not self.active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self._slot_holds[slot] += 1
+
+    def release_slot_hold(self, slot: int) -> None:
+        """Drop one migration hold on ``slot`` (see :meth:`hold_slot`)."""
+        if self._slot_holds[slot] <= 0:
+            raise ValueError(f"slot {slot} has no outstanding hold")
+        self._slot_holds[slot] -= 1
+
+    @property
+    def held_slots(self) -> list:
+        return [s for s in range(self.max_slots) if self._slot_holds[s] > 0]
 
     # -- reference counting / prefix sharing -------------------------------
 
@@ -1077,8 +1131,11 @@ class PagedKVCache:
         gathered bytes exactly, across any pool geometry."""
         self._require_pool("adopt_packed")
         if self.kv_codec == "fp":
-            raise ValueError("adopt_packed is for quantized tiers; "
-                             "fp pools adopt fp rows via adopt()")
+            raise KVTierMismatchError(
+                offered="quantized", pool=self.kv_codec,
+                where="adopt_packed",
+                detail="packed payloads are for quantized tiers; fp pools "
+                       "adopt fp rows via adopt()")
         self.ensure(slot, length)
         self.prepare_write(slot, length, start=0)
         dest = jnp.asarray(self._flat_indices(slot, length))
@@ -1121,13 +1178,53 @@ class PagedKVCache:
                 "v_scale": np.asarray(vs)[:, :n],
                 "length": np.asarray(n, np.int32)}
 
+    def _check_row_range(self, slot: int, start: int, stop: int) -> None:
+        if not 0 <= start < stop <= int(self.lengths[slot]):
+            raise ValueError(
+                f"row range [{start}, {stop}) out of slot {slot}'s "
+                f"length {int(self.lengths[slot])}")
+
+    def gather_slot_rows(self, slot: int, start: int, stop: int) -> dict:
+        """Row range ``[start, stop)`` of :meth:`gather_slot` — the per-page
+        migration chunk (a handoff seals, ships, and verifies one page at a
+        time; under :meth:`hold_slot` the flat indices stay stable across
+        the whole ranged walk)."""
+        self._require_pool("gather_slot_rows")
+        self._check_row_range(slot, start, stop)
+        idx = jnp.asarray(self._flat_indices(slot, stop)[start:])
+        if self.kv_codec == "fp":
+            k, v = _gather_impl(self.pool.k, self.pool.v, idx)
+        else:
+            k, v = _gather_quant_impl(self.pool, idx, kv_codec=self.kv_codec)
+        return {"k": np.asarray(k), "v": np.asarray(v)}
+
+    def gather_slot_rows_packed(self, slot: int, start: int,
+                                stop: int) -> dict:
+        """Row range ``[start, stop)`` of :meth:`gather_slot_packed` — raw
+        pool bytes for one migrated page, so the packed adopt on the far
+        side is a byte move."""
+        self._require_pool("gather_slot_rows_packed")
+        if self.kv_codec == "fp":
+            raise ValueError("gather_slot_rows_packed is for quantized "
+                             "tiers; fp pools use gather_slot_rows()")
+        self._check_row_range(slot, start, stop)
+        idx = jnp.asarray(self._flat_indices(slot, stop)[start:])
+        kc, vc, ks, vs = _gather_packed_impl(self.pool, idx)
+        return {"k_codes": np.asarray(kc), "v_codes": np.asarray(vc),
+                "k_scale": np.asarray(ks), "v_scale": np.asarray(vs)}
+
     def defrag(self) -> int:
         """Compact allocated pages to the low end of the pool (slot order,
         trash page fixed at 0) and rebuild the free list above them. Returns
         the number of pages that moved. One donated device gather; page
         tables are rewritten to match, so every slot's logical content is
-        unchanged."""
+        unchanged. Deferred (returns 0) while any slot holds a migration
+        pin — a compaction would invalidate the in-flight transfer's
+        flat-index snapshot."""
         self._require_pool("defrag")
+        if self._slot_holds.any():
+            self.deferred_defrags += 1
+            return 0
         # src (new -> old) must be a TRUE permutation: after alloc/grow/free
         # churn an owned page's compacted destination can be a currently-free
         # page with a HIGHER id (e.g. slot pages [[4],[2],[1]] with page 3
@@ -1223,10 +1320,8 @@ class PagedKVCache:
             # a whole pool would change every page's bytes under checkpoints
             # that promise bit-exact round-trips — the caller must build a
             # cache at the checkpoint's tier instead.
-            raise ValueError(
-                f"KV tier mismatch: checkpoint stores {ck!r} pages, this "
-                f"cache is {self.kv_codec!r}; rebuild the pool with "
-                f"kv_codec={ck!r} (at-rest transcoding is refused)")
+            raise KVTierMismatchError(offered=ck, pool=self.kv_codec,
+                                      where="load_state_dict")
         if self.kv_codec == "fp":
             if state["k"].shape != self.pool.k.shape:
                 raise ValueError(
@@ -1340,6 +1435,8 @@ class PagedKVCache:
                 assert not self._slot_pages[s], f"inactive slot {s} owns pages"
                 assert (self.page_table[s] == 0).all()
                 assert self.lengths[s] == 0
+                assert self._slot_holds[s] == 0, \
+                    f"inactive slot {s} carries a migration hold"
 
     def prefix_report(self) -> dict:
         """Host-side sharing stats for ``ContinuousBatcher.report()`` and

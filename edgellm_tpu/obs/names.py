@@ -80,6 +80,15 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "edgellm_cluster_readmitted_total",
     "edgellm_cluster_recompute_tokens_total",
     "edgellm_cluster_autoscale_events_total",
+    # disaggregated prefill/decode (serve/disagg.py)
+    "edgellm_disagg_migrations_total",
+    "edgellm_disagg_pages_migrated_total",
+    "edgellm_disagg_wire_bytes_total",
+    "edgellm_disagg_recompute_tokens_total",
+    "edgellm_disagg_readmitted_total",
+    "edgellm_disagg_prefill_workers",
+    "edgellm_disagg_queue_depth",
+    "edgellm_disagg_degraded",
 })
 
 #: templates for adapter families whose middle segment is a runtime key
@@ -131,6 +140,15 @@ SPAN_NAMES: FrozenSet[str] = frozenset({
     "cluster.kill",
     "cluster.respawn",
     "cluster.autoscale",
+    # serve/disagg.py migration lifecycle (per-page hop attribution rides
+    # on disagg.migrate_page's sid/wid/page attrs)
+    "disagg.prefill",
+    "disagg.migrate",
+    "disagg.migrate_page",
+    "disagg.adopt",
+    "disagg.degrade",
+    "disagg.kill",
+    "disagg.readmit",
 })
 
 #: span-name templates (none yet — span names are all static today); kept so

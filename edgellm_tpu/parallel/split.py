@@ -37,8 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models.configs import ModelConfig
 from ..models.transformer import (block, block_decode, block_verify, embed,
                                   unembed, precompute_rope, KVCache)
-from ..models.paged_kv import block_decode_paged, block_decode_paged_quant, \
-    resolve_kv_codec
+from ..models.paged_kv import KVTierMismatchError, block_decode_paged, \
+    block_decode_paged_quant, resolve_kv_codec
 from ..codecs.packing import get_wire_codec, WireCodec
 from ..codecs.faults import FaultConfig, FaultyLink, LinkPolicy, sum_counters
 from ..codecs.pallas_kernels import fused_hop, fused_hop_plan
@@ -1632,8 +1632,11 @@ class SplitRuntime:
         scales, no requantize, so evict -> readmit is bit-exact."""
         codec = self._pool_codec(pool)
         if codec == "fp":
-            raise ValueError("adopt_paged_rows_packed needs a quantized "
-                             "pool; fp pools adopt fp rows")
+            raise KVTierMismatchError(
+                offered="quantized", pool=codec,
+                where="adopt_paged_rows_packed",
+                detail="packed payloads need a quantized pool; fp pools "
+                       "adopt fp rows via adopt_paged_rows")
         return self._pool_dict(_adopt_paged_packed_impl(
             self._pool_arrays(pool), jnp.asarray(k_codes),
             jnp.asarray(v_codes), jnp.asarray(k_scale),
@@ -1677,8 +1680,11 @@ class SplitRuntime:
         raw pool bytes, so the adopt_paged_rows_packed round-trip is
         bit-exact by construction."""
         if self._pool_codec(pool) == "fp":
-            raise ValueError("gather_paged_packed needs a quantized pool; "
-                             "fp pools use gather_paged")
+            raise KVTierMismatchError(
+                offered="quantized", pool="fp",
+                where="gather_paged_packed",
+                detail="the packed gather form needs a quantized pool; fp "
+                       "pools use gather_paged")
         out = _gather_paged_packed_impl(self._pool_arrays(pool),
                                         jnp.asarray(idx, jnp.int32))
         return tuple(np.asarray(a) for a in out)

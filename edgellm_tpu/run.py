@@ -120,6 +120,7 @@ _PARAM_KEYS = {
     "kv_at_rest": "serve",
     "speculative": "serve",
     "cluster": "serve",
+    "disagg": "serve",
     "max_compiles": "distances",
     "observability": "all",
 }
@@ -556,6 +557,43 @@ def _validate_params_json(p: dict) -> None:
                 "single-front chaos hook — replica kills belong to the "
                 "router (ClusterFront.kill_replica, exercised by the "
                 "cluster tests/bench)")
+    if "disagg" in p:
+        from .codecs.faults import FaultConfig
+        from .codecs.fec import FECConfig, HedgeConfig
+        from .serve.disagg import DisaggConfig
+
+        if exp != "serve":
+            die("disagg only applies to experiment 'serve'")
+        if "speculative" in p:
+            die("disagg + speculative: the spec loop is single-stream with "
+                "no prefill/decode split story — drop one of the two blocks")
+        if "batching" not in p:
+            die("disagg splits the continuous batcher into prefill and "
+                "decode workers — add a 'batching' block")
+        dg = p["disagg"]
+        if not isinstance(dg, dict):
+            die(f"disagg must be an object of DisaggConfig fields, "
+                f"got {dg!r}")
+        top = {f.name for f in dataclasses.fields(DisaggConfig)}
+        bad = sorted(set(dg) - top)
+        if bad:
+            die(f"disagg: unknown field(s) {bad}; known: {sorted(top)}")
+        for key, cls in (("fec", FECConfig), ("hedge", HedgeConfig),
+                         ("faults", FaultConfig)):
+            if dg.get(key) is None:
+                continue
+            if not isinstance(dg[key], dict):
+                die(f"disagg.{key} must be an object of {cls.__name__} "
+                    f"fields, got {dg[key]!r}")
+            fields = {f.name for f in dataclasses.fields(cls)}
+            bad = sorted(set(dg[key]) - fields)
+            if bad:
+                die(f"disagg.{key}: unknown field(s) {bad}; "
+                    f"known: {sorted(fields)}")
+        try:
+            _disagg_config(dg)
+        except (TypeError, ValueError) as e:
+            die(f"disagg: {e}")
 
 
 def _pipeline_config(p: dict):
@@ -606,6 +644,24 @@ def _cluster_config(cl: dict):
         if key in kwargs:
             kwargs[key] = cls(**kwargs[key])
     return ClusterConfig(**kwargs)
+
+
+def _disagg_config(dg: dict):
+    """Build the :class:`DisaggConfig` a ``"disagg"`` params block
+    describes — nested migration-ladder objects (``fec``, ``hedge``,
+    ``faults``) become the matching codec configs. Raises
+    ``TypeError``/``ValueError`` on bad fields; the validator turns those
+    into field-naming ``die()``s."""
+    from .codecs.faults import FaultConfig
+    from .codecs.fec import FECConfig, HedgeConfig
+    from .serve.disagg import DisaggConfig
+
+    kwargs = dict(dg)
+    for key, cls in (("fec", FECConfig), ("hedge", HedgeConfig),
+                     ("faults", FaultConfig)):
+        if kwargs.get(key) is not None:
+            kwargs[key] = cls(**kwargs[key])
+    return DisaggConfig(**kwargs)
 
 
 def _attach_front_obs(front) -> None:
@@ -1068,6 +1124,21 @@ def main(argv=None) -> int:
                 if rt is not None:
                     split_kw = dict(split_runtime=rt,
                                     placed_params=rt.place_params(params))
+                dcfg = (_disagg_config(params_json["disagg"])
+                        if "disagg" in params_json else None)
+
+                def make_batcher():
+                    # the disaggregated front mirrors the batcher surface
+                    # (submit/run/report/discard), so everything downstream —
+                    # ServeFront.drain_batched, the cluster replica factory —
+                    # is agnostic to which one it drives
+                    if dcfg is not None:
+                        from .serve.disagg import DisaggServer
+
+                        return DisaggServer(cfg, params, bcfg, dcfg,
+                                            **split_kw)
+                    return ContinuousBatcher(cfg, params, bcfg, **split_kw)
+
                 if "cluster" in params_json:
                     # replica-router path (REPRODUCING §20): N continuous-
                     # batching fronts behind prefix-affinity placement; every
@@ -1080,9 +1151,8 @@ def main(argv=None) -> int:
                     ccfg = _cluster_config(params_json["cluster"])
 
                     def replica_factory(replica_id, generation):
-                        b = ContinuousBatcher(cfg, params, bcfg, **split_kw)
                         return ServeFront(cfg, params, config=front_cfg,
-                                          clock=clock, batcher=b)
+                                          clock=clock, batcher=make_batcher())
 
                     cluster = ClusterFront(replica_factory, ccfg,
                                            clock=clock)
@@ -1123,8 +1193,9 @@ def main(argv=None) -> int:
                             outcomes.get(rec.outcome, 0) + 1)
                     artifact = {
                         "requests": len(records), "outcomes": outcomes,
-                        "mode": ("cluster_batched_split" if rt is not None
-                                 else "cluster_batched"),
+                        "mode": (("disagg_" if dcfg is not None else "")
+                                 + ("cluster_batched_split" if rt is not None
+                                    else "cluster_batched")),
                         "cluster": rep,
                         "records": [r.as_dict() for r in records]}
                     with open(out("cluster_report.json"), "w") as f:
@@ -1142,7 +1213,7 @@ def main(argv=None) -> int:
                             f"request(s) unterminated — the router lost "
                             f"work: {rep}")
                     return 0
-                batcher = ContinuousBatcher(cfg, params, bcfg, **split_kw)
+                batcher = make_batcher()
                 front = ServeFront(cfg, params, config=front_cfg,
                                    clock=clock, batcher=batcher)
                 _attach_front_obs(front)
@@ -1178,8 +1249,9 @@ def main(argv=None) -> int:
                 for rec in records:
                     outcomes[rec.outcome] = outcomes.get(rec.outcome, 0) + 1
                 artifact = {"requests": len(records), "outcomes": outcomes,
-                            "mode": ("batched_split" if rt is not None
-                                     else "batched"),
+                            "mode": (("disagg_" if dcfg is not None else "")
+                                     + ("batched_split" if rt is not None
+                                        else "batched")),
                             "batcher": rep,
                             "records": [r.as_dict() for r in records]}
                 with open(out("serve_report.json"), "w") as f:
@@ -1196,6 +1268,9 @@ def main(argv=None) -> int:
                     **({"prefix_hit_rate": round(pf["hit_rate"], 4),
                         "prefill_tokens_saved": pf["saved_tokens"]}
                        if pf else {}),
+                    **({"disagg_migrations": rep["disagg"]["migrations"],
+                        "disagg_degraded": rep["disagg"]["degraded"]}
+                       if rep.get("disagg") else {}),
                     "artifact": out("serve_report.json")}))
                 if args.serve_report:
                     _print_serve_report(front.report())

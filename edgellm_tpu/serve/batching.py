@@ -77,7 +77,8 @@ from ..obs.flight import flight_dump_for
 from ..obs.tracing import span as obs_span
 from ..utils.concurrency import guarded_by
 from .decode import _prefill_jit, _prefill_suffix_jit, _sample
-from .recovery import CheckpointError, DecodeCheckpoint, Watchdog
+from .recovery import (CheckpointError, CheckpointTierMismatchError,
+                       DecodeCheckpoint, Watchdog)
 
 
 def _model_sig(cfg: ModelConfig) -> dict:
@@ -151,6 +152,7 @@ class Stream:
     slot: int = -1
     tokens: list = field(default_factory=list)  # sampled ids, host ints
     resume: Optional[dict] = None  # gathered {"k","v","length"} for re-admit
+    resume_prefix: bool = False   # re-publish the prompt's pages on adopt
     admit_seq: int = -1           # admission order; youngest = largest
     evictions: int = 0
 
@@ -473,6 +475,13 @@ class ContinuousBatcher:
                 self.pool.adopt(slot, jnp.asarray(st.resume["k"]),
                                 jnp.asarray(st.resume["v"]), need_len)
             st.resume = None
+            if st.resume_prefix and self.pool.prefix is not None:
+                # migration adopts opt in to re-publishing: the payload's
+                # first ``prompt.size`` rows are pure prompt KV (the prefill
+                # worker hands off at t == 1), so the radix index survives
+                # the transfer. register_prefix walks only the prompt
+                # tokens — generated rows are never indexed.
+                self.pool.register_prefix(slot, st.prompt)
             return None
         s = st.prompt.size
         matched = 0
@@ -624,6 +633,62 @@ class ContinuousBatcher:
                 self.checkpoint_stream(
                     sid, os.path.join(self.bcfg.checkpoint_dir,
                                       f"stream_{sid}.ckpt"))
+
+    # -- disaggregated prefill handoff ------------------------------------
+
+    def prefill_hold(self, sid: int) -> Optional[Stream]:
+        """Disaggregated-prefill admission: admit waiting stream ``sid``
+        NOW — the exact fresh-admit prefill runs and token 0 is sampled
+        with the same ``fold_in(key, 0)`` as colocated serving — then pin
+        its slot with a migration hold instead of decoding. The caller
+        (``serve.disagg``'s prefill worker) streams the slot's pages out
+        via :meth:`gather_rows` and retires it with
+        :meth:`release_handoff`. Returns the Stream, or None when the pool
+        cannot admit right now. A ``max_new_tokens == 1`` stream finishes
+        at admission (token 0 is the whole answer) and comes back already
+        ``finished`` with no held slot."""
+        st = self._streams[sid]
+        if st.status != "waiting":
+            raise ValueError(f"stream {sid} is not waiting")
+        if not self._try_admit(sid):
+            return None
+        self._waiting.remove(sid)
+        if st.status == "running":
+            self.pool.hold_slot(st.slot)
+        return st
+
+    def gather_rows(self, slot: int, start: int, stop: int) -> dict:
+        """Rows ``[start, stop)`` of ``slot`` in the pool's at-rest form —
+        one migrated page's payload chunk (packed codes + scales on
+        quantized tiers, fp rows otherwise; split mode gathers the
+        per-stage layout). Concatenating every chunk along the row axis
+        reproduces :meth:`_gather_state`'s arrays exactly."""
+        if self.rt is None:
+            if self.bcfg.kv_codec != "fp":
+                return self.pool.gather_slot_rows_packed(slot, start, stop)
+            return self.pool.gather_slot_rows(slot, start, stop)
+        idx = self.pool._flat_indices(slot, stop)[start:]
+        if self.bcfg.kv_codec != "fp":
+            kc, vc, ks, vs = self.rt.gather_paged_packed(
+                self._split_pool, idx)
+            return {"k_codes": kc, "v_codes": vc,
+                    "k_scale": ks, "v_scale": vs}
+        k_seq, v_seq = self.rt.gather_paged(self._split_pool, idx)
+        return {"k": k_seq, "v": v_seq}
+
+    def release_handoff(self, sid: int) -> None:
+        """Retire a prefill-handoff stream: drop the migration hold and
+        free the staging slot (its pages have verifiably landed in the
+        decode pool, or the handoff was abandoned). The prompt's pages
+        stay in the staging prefix index, if enabled, for later shared
+        prefills."""
+        st = self._streams.pop(sid)
+        if st.status == "running":
+            self.pool.release_slot_hold(st.slot)
+            self.pool.free_slot(st.slot)
+            del self._slot_to_sid[st.slot]
+            st.status, st.slot = "finished", -1
+        self.results.pop(sid, None)
 
     def _evict_for_pages(self, needed: int, protect: set) -> bool:
         """Evict youngest-admitted running streams (never ``protect``) until
@@ -890,10 +955,10 @@ class ContinuousBatcher:
             # checkpoint's tier; rewriting them would silently change the
             # stream's numerics mid-flight (paged_kv.load_state_dict makes
             # the same call for whole-pool snapshots)
-            raise CheckpointError(
-                f"{path} stores {ck!r} KV pages, this batcher's pool is "
-                f"{self.bcfg.kv_codec!r}; restore into a batcher built at "
-                f"the checkpoint's tier (transcoding is refused)")
+            raise CheckpointTierMismatchError(
+                offered=ck, pool=self.bcfg.kv_codec, where="restore_stream",
+                detail=f"{path} stores {ck!r} KV pages; restore into a "
+                       f"batcher built at the checkpoint's tier")
         if self.rt is not None:
             pipe = getattr(self.rt, "pipeline", None)
             want = {"cuts": [int(c) for c in self.rt.split.cuts],

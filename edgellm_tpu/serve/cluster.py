@@ -244,7 +244,21 @@ class Replica:
             "respawn_at": self.respawn_at,
             "breaker": self.breaker.summary(),
             "retry_budget": self.budget.summary(),
+            "disagg": self.disagg_state(),
         }
+
+    def disagg_state(self) -> Optional[dict]:
+        """The front's disaggregation state (degraded flag + typed reason),
+        or ``None`` when the replica serves a plain colocated batcher."""
+        probe = getattr(self.front, "disagg_state", None)
+        return probe() if callable(probe) else None
+
+    def _disagg_penalty(self) -> int:
+        """1 when this replica's disagg front has degraded to colocated
+        serving, else 0 — folded into the placement sort keys so healthy
+        disaggregated peers win ties and absorb new load first."""
+        st = self.disagg_state()
+        return 1 if (st is not None and st["degraded"]) else 0
 
 
 @dataclasses.dataclass
@@ -457,12 +471,17 @@ class ClusterFront:
             for r in cands:
                 shared = r.front.probe_prefix(req.prompt_ids)
                 if shared >= self.cfg.min_affinity_tokens:
-                    key = (-shared, r.front.queue_depth, r.id)
+                    # a degraded disagg replica still wins on a strong
+                    # prefix hit (the shared KV outweighs colocated
+                    # throughput) but loses every tie to a healthy peer
+                    key = (-shared, r._disagg_penalty(),
+                           r.front.queue_depth, r.id)
                     if best is None or key < best[0]:
                         best = (key, r)
             if best is not None:
                 return best[1], "affinity"
-        r = min(cands, key=lambda c: (c.front.queue_depth, c.id))
+        r = min(cands, key=lambda c: (c._disagg_penalty(),
+                                      c.front.queue_depth, c.id))
         return r, "least_loaded"
 
     def submit(self, req: Request) -> int:

@@ -578,6 +578,17 @@ class ServeFront:
             plan["mode"] = "batched_split"
             plan["cuts"] = [int(c) for c in self.batcher.rt.split.cuts]
             plan["hop_codecs"] = [c.name for c in self.batcher.rt.codecs]
+        if rep.get("disagg"):
+            # disaggregated prefill/decode front: the per-drain record carries
+            # the migration scoreboard so a degrade mid-soak is attributable
+            # to the drain where it happened
+            plan["mode"] = ("disagg_split" if plan["mode"] == "batched_split"
+                            else "disagg")
+            plan["disagg"] = {
+                "degraded": rep["disagg"]["degraded"],
+                "degrade_reason": rep["disagg"]["degrade_reason"],
+                "migrations": rep["disagg"]["migrations"],
+                "recompute_tokens": rep["disagg"]["recompute_tokens"]}
         for sid in sorted(inflight):
             pend, wait, started = inflight[sid]
             b, s = pend.prompt.shape
@@ -946,7 +957,28 @@ class ServeFront:
             **({"prefix": self.batcher.pool.prefix_report()}
                if (self.batcher is not None
                    and self.batcher.pool.prefix is not None) else {}),
+            # present only when this front drains a disaggregated server:
+            # degrade state + migration scoreboard for --serve-report and
+            # the cluster router's placement probe
+            **({"disagg": self.disagg_state()}
+               if self.disagg_state() is not None else {}),
         }
+
+    def disagg_state(self) -> Optional[dict]:
+        """Degrade state of a disaggregated batcher, or ``None`` for a plain
+        colocated front.
+
+        The cluster router probes this before placement: a replica whose
+        disagg front has degraded to colocated serving still answers
+        correctly (token-identical by construction) but at colocated
+        throughput, so it should lose placement preference to healthy
+        disaggregated peers.
+        """
+        b = self.batcher
+        if b is None or not hasattr(b, "degrade_reason"):
+            return None
+        return {"degraded": bool(b.degraded),
+                "degrade_reason": b.degrade_reason}
 
     # -- live telemetry ----------------------------------------------------
 

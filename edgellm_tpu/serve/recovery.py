@@ -43,6 +43,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 import jax
 
+from ..models.paged_kv import KVTierMismatchError
 from ..models.transformer import KVCache, decode_step, prefill
 from ..obs.flight import flight_dump_for
 from ..obs.tracing import span as obs_span
@@ -58,6 +59,13 @@ class CheckpointError(RuntimeError):
     """A decode checkpoint could not be written or restored (missing file,
     bad magic, truncation, checksum mismatch, or a plan/model signature that
     does not match the resuming runtime)."""
+
+
+class CheckpointTierMismatchError(KVTierMismatchError, CheckpointError):
+    """A checkpoint's KV pages are at a different ``kv_codec`` tier than the
+    restoring pool. One error type for both audiences: checkpoint callers
+    (``except CheckpointError``) and the unified cross-tier refusal surface
+    (``except KVTierMismatchError``) — restore never transcodes."""
 
 
 class DecodeTimeout(TimeoutError):
