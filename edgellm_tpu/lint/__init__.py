@@ -1,15 +1,23 @@
 """graphlint: static analysis for the split-decode stack.
 
-Two layers behind one CLI (``python -m edgellm_tpu.lint``, REPRODUCING §8):
+Four layers behind one CLI (``python -m edgellm_tpu.lint``, REPRODUCING §8):
 
 - **AST rules** (:mod:`.ast_rules`): JAX footguns ruff can't see — traced
   branches, host I/O under jit, numpy-on-tracer, missing static_argnames,
   per-token host syncs in decode loops, trace-time container mutation.
+- **Thread/lock discipline** (:mod:`.threadlint`): EG1xx rules for the
+  host-side serving stack — locks around shared batcher/pool state, no
+  blocking calls under a lock, condition-variable hygiene.
 - **Graph contracts** (:mod:`.contracts` + :mod:`.entrypoints`): production
   entry points declare their compiled-graph invariants with
   :func:`graph_contract`; the lint CLI traces the real functions and
   verifies collective counts, wire dtypes/bytes, no-f64, no-host-callback,
   KV-cache donation, and disabled-config graph identity.
+- **Config lattice** (:mod:`.lattice`, REPRODUCING §22): every
+  ``configs/*.json`` must validate, AOT-lower its entry points under its
+  ``"budget"`` block with donation intact, and keep a README row; the
+  feature lattice is fuzzed pairwise against the typed-refusal oracle and
+  the result lands in ``capability_matrix.json``.
 
 This ``__init__`` stays import-light on purpose: production modules import
 :func:`graph_contract` from here at module import time, so pulling drivers

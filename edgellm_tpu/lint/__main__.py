@@ -45,6 +45,12 @@ def main(argv=None) -> int:
     ap.add_argument("--thread-only", action="store_true",
                     help="run only the thread/lock-discipline layer "
                          "(EG1xx; no jax import)")
+    ap.add_argument("--lattice-only", action="store_true",
+                    help="run only the config-lattice verifier (latticelint:"
+                         " AOT footprint + donation + pairwise compat)")
+    ap.add_argument("--matrix", metavar="PATH", default=None,
+                    help="where the lattice layer writes the capability "
+                         "matrix (CI uploads capability_matrix.json)")
     ap.add_argument("--no-mypy", action="store_true",
                     help="skip the scoped mypy --strict layer")
     ap.add_argument("--show-suppressed", action="store_true",
@@ -54,10 +60,13 @@ def main(argv=None) -> int:
                     help="AST/thread-lint these files instead of the package "
                          "(graph layer always targets the real package)")
     args = ap.parse_args(argv)
-    only_flags = [args.ast_only, args.graph_only, args.thread_only]
+    only_flags = [args.ast_only, args.graph_only, args.thread_only,
+                  args.lattice_only]
     if sum(only_flags) > 1:
-        ap.error("--ast-only, --graph-only and --thread-only are "
-                 "mutually exclusive")
+        ap.error("--ast-only, --graph-only, --thread-only and "
+                 "--lattice-only are mutually exclusive")
+    if args.lattice_only and args.paths:
+        ap.error("--lattice-only lints configs/, not source paths")
 
     from .report import LintReport, merge, to_sarif
 
@@ -67,7 +76,7 @@ def main(argv=None) -> int:
     checked: list = []
     skipped: list = []
 
-    if not (args.graph_only or args.thread_only):
+    if not (args.graph_only or args.thread_only or args.lattice_only):
         from .ast_rules import iter_package_files, lint_paths
 
         targets = args.paths or list(iter_package_files(pkg_root))
@@ -80,7 +89,7 @@ def main(argv=None) -> int:
             findings_by_layer.append(ty_findings)
             skipped.extend(ty_skips)
 
-    if not (args.ast_only or args.graph_only):
+    if not (args.ast_only or args.graph_only or args.lattice_only):
         # pure-AST layer like the EG00x rules: runs pre-jax-bootstrap
         from .threadlint import lint_files as thread_lint_files
         from .threadlint import lint_package as thread_lint_package
@@ -90,7 +99,7 @@ def main(argv=None) -> int:
         else:
             findings_by_layer.append(thread_lint_package(pkg_root))
 
-    if not (args.ast_only or args.thread_only):
+    if not (args.ast_only or args.thread_only or args.lattice_only):
         _bootstrap_jax()
         from .entrypoints import run_graph_checks
 
@@ -98,6 +107,20 @@ def main(argv=None) -> int:
         findings_by_layer.append(g_findings)
         checked.extend(g_checked)
         skipped.extend(g_skips)
+
+    if not (args.ast_only or args.thread_only or args.graph_only
+            or args.paths):
+        # layer 4: the config-lattice verifier (AOT footprints, donation
+        # coverage, pairwise feature compat) + the capability-matrix artifact
+        _bootstrap_jax()
+        from .lattice import run_lattice_checks, write_matrix
+
+        l_findings, l_checked, l_skips, matrix = run_lattice_checks()
+        findings_by_layer.append(l_findings)
+        checked.extend(l_checked)
+        skipped.extend(l_skips)
+        if args.matrix:
+            write_matrix(matrix, args.matrix)
 
     if args.show_suppressed:
         from .ast_rules import collect_suppressions, iter_package_files

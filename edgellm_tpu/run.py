@@ -123,6 +123,7 @@ _PARAM_KEYS = {
     "disagg": "serve",
     "max_compiles": "distances",
     "observability": "all",
+    "budget": "all (latticelint AOT peak)",
 }
 _EXPERIMENTS = ("", "initial", "last_row", "relevance", "split", "distances",
                 "serve")
@@ -165,6 +166,26 @@ def _validate_params_json(p: dict) -> None:
             ObservabilityConfig(**ob)
         except (TypeError, ValueError) as e:
             die(f"observability: {e}")
+    if "budget" in p:
+        # the latticelint contract: a shipped config pins its lint-geometry
+        # AOT peak so a graph change that balloons temp bytes is a finding
+        b = p["budget"]
+        if not isinstance(b, dict):
+            die(f"budget must be an object with 'aot_peak_bytes' (and an "
+                f"optional 'note'), got {b!r}")
+        bad = sorted(set(b) - {"aot_peak_bytes", "note"})
+        if bad:
+            die(f"budget: unknown field(s) {bad}; "
+                f"known: ['aot_peak_bytes', 'note']")
+        if "aot_peak_bytes" not in b:
+            die("budget needs 'aot_peak_bytes' (the latticelint AOT ceiling)")
+        if (not isinstance(b["aot_peak_bytes"], int)
+                or isinstance(b["aot_peak_bytes"], bool)
+                or b["aot_peak_bytes"] < 1):
+            die(f"budget.aot_peak_bytes must be a positive integer, "
+                f"got {b['aot_peak_bytes']!r}")
+        if "note" in b and not isinstance(b["note"], str):
+            die(f"budget.note must be a string, got {b['note']!r}")
     if exp not in ("split", "serve") and (
             "faults" in p or "link_policy" in p or "fec" in p
             or "hedge" in p or "link_health" in p):
@@ -478,6 +499,12 @@ def _validate_params_json(p: dict) -> None:
                 die("pipeline + speculative: the spec loop verifies one "
                     "stream at a time (B == 1), leaving nothing to "
                     "micro-batch — drop one of the two blocks")
+        if pc.enabled and p.get("kv_at_rest", {}).get("codec", "fp") != "fp":
+            # mirror of _paged_decode_fns_quant's refusal: the µ-batch
+            # trash-page routing has no quant twin
+            die("kv_at_rest + pipeline: quantized paged decode composes "
+                "with the unpipelined split runtime only — drop 'pipeline' "
+                "or use codec 'fp'")
     if "speculative" in p:
         from .serve.speculative import SpecConfig
 

@@ -14,24 +14,22 @@ drained group's and the in-flight next group's, from the submit/drain
 double-buffering) plus the captured stats, which are added analytically.
 ``budget_frac`` absorbs what the estimate cannot see (allocator slack,
 fragmentation, the small executables).
+
+The lower/compile/``memory_analysis()`` primitive lives in
+:mod:`edgellm_tpu.analysis.aot` — shared with the config-lattice verifier
+(``lint/lattice.py``) so the two AOT consumers cannot drift.
 """
 from __future__ import annotations
 
 import os
 from typing import Optional, Sequence
 
+from ..analysis.aot import call_total_bytes, is_over_hbm
+
 DEFAULT_HBM_BYTES = int(15.75 * 2 ** 30)  # TPU v5e; override with BENCH_HBM_GB
 
-
-def _is_over_hbm(e: BaseException) -> bool:
-    """True when a compile failed because the program provably exceeds HBM
-    ('Program hbm requirement ...G' dump) — extends the runtime-OOM vocabulary
-    of :func:`edgellm_tpu.eval.harness.is_oom_error` to compile time."""
-    from ..eval.harness import is_oom_error
-
-    msg = str(e)
-    return ("hbm requirement" in msg or "allocations in hbm" in msg
-            or is_oom_error(e))
+#: back-compat alias — callers and tests predate the analysis.aot extraction
+_is_over_hbm = is_over_hbm
 
 
 def _budget_bytes(hbm_bytes: Optional[int], budget_frac: float) -> int:
@@ -67,19 +65,10 @@ def estimate_sweep_peak_bytes(cfg, window_batch: int, max_length: int,
     ids = jax.ShapeDtypeStruct((W, S), jnp.int32)
     targets = jax.ShapeDtypeStruct((W, S), jnp.int32)
 
-    def call_bytes(lowered) -> Optional[int]:
-        """argument+output+temp bytes, or None when the TPU compiler itself
-        rejects the program as over-HBM — a provable doesn't-fit, still with
-        zero allocation."""
-        try:
-            compiled = lowered.compile()
-        except Exception as e:
-            if _is_over_hbm(e):
-                return None
-            raise
-        ma = compiled.memory_analysis()
-        return int(ma.argument_size_in_bytes + ma.output_size_in_bytes
-                   + ma.temp_size_in_bytes)
+    # argument+output+temp bytes, or None when the TPU compiler itself
+    # rejects the program as over-HBM — a provable doesn't-fit, still with
+    # zero allocation (shared driver: analysis/aot.py)
+    call_bytes = call_total_bytes
 
     want_final = codec in DEDUP_ZERO_CODECS
     stats = call_bytes(_stats_forward(cfg, layers, want_final=want_final)
@@ -160,16 +149,8 @@ def largest_fitting_relevance_batch(cfg, requested: int, *, max_length: int,
     wb = requested
     while wb > min_window_batch:
         ids = jax.ShapeDtypeStruct((wb, max_length), jnp.int32)
-        try:
-            compiled = _chunk_relevance(cfg).lower(params_shape, ids).compile()
-        except Exception as e:
-            if _is_over_hbm(e):
-                wb = max(wb // 2, min_window_batch)
-                continue
-            raise
-        ma = compiled.memory_analysis()
-        if (ma.argument_size_in_bytes + ma.output_size_in_bytes
-                + ma.temp_size_in_bytes) <= budget:
+        total = call_total_bytes(_chunk_relevance(cfg).lower(params_shape, ids))
+        if total is not None and total <= budget:
             return wb
         wb = max(wb // 2, min_window_batch)
     return wb

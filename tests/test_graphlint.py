@@ -32,6 +32,12 @@ def _fixture(name: str) -> str:
     return os.path.join(FIXTURES, name)
 
 
+def _repo_root():
+    import pathlib
+
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
 def _rules(findings):
     return {f.rule for f in findings}
 
@@ -362,13 +368,24 @@ def test_cli_json_report_unchanged_shape(tmp_path):
 
 @pytest.mark.slow
 def test_cli_zero_on_real_package(tmp_path):
-    """Acceptance: the full CLI (AST + graph contracts) exits 0 on the real
-    package. Slow — it traces every entry point; CI's graphlint job runs it
-    as the required gate."""
+    """Acceptance: the full CLI (AST + graph contracts + config lattice)
+    exits 0 on the real package. Slow — it traces every entry point and
+    AOT-lowers every config; CI's graphlint/latticelint jobs run it as the
+    required gate."""
     report_path = tmp_path / "report.json"
-    proc = _run_cli("--no-mypy", "--json", str(report_path))
+    matrix_path = tmp_path / "capability_matrix.json"
+    proc = _run_cli("--no-mypy", "--json", str(report_path),
+                    "--matrix", str(matrix_path))
     assert proc.returncode == 0, proc.stdout + proc.stderr
     import json
 
     report = json.loads(report_path.read_text())
     assert report["ok"] and len(report["checked_contracts"]) >= 8
+    # the lattice layer ran and covered every shipped config
+    lattice = [c for c in report["checked_contracts"]
+               if c.startswith("lattice.config:")]
+    n_configs = len(list((_repo_root() / "configs").glob("*.json")))
+    assert len(lattice) == n_configs
+    assert "lattice.pairwise-compat" in report["checked_contracts"]
+    matrix = json.loads(matrix_path.read_text())
+    assert len(matrix["configs"]) == n_configs

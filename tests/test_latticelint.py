@@ -1,0 +1,346 @@
+"""latticelint test coverage: the pair oracle matches run.py's validator
+message-for-message, the documented invalid feature combos die with their
+exact typed errors, README parity / donation / budget checks each catch a
+seeded-bad fixture with exactly one finding, and capability_matrix.json has
+the documented shape.
+
+The full 26-config AOT sweep is the slow CLI acceptance test at the bottom
+(CI's latticelint job runs the same command as the required gate); the
+tier-1 tests here use either pure validation or a two-config fixture
+directory so they stay cheap.
+"""
+import dataclasses
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from edgellm_tpu.lint import lattice
+from edgellm_tpu.lint.lattice import (MATRIX_SCHEMA, PAIR_ORACLE,
+                                      compose_combo, donation_findings,
+                                      readme_parity_findings,
+                                      run_lattice_checks, write_matrix)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CONFIGS = REPO / "configs"
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# the pair oracle is exact, both directions
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pair", sorted(PAIR_ORACLE))
+def test_pair_oracle_matches_validator(pair):
+    """Every refused pair dies with the exact message the oracle pins."""
+    assert lattice._validate(compose_combo(pair)) == PAIR_ORACLE[pair]
+
+
+@pytest.mark.parametrize("name", sorted(lattice.FUZZ_BLOCKS))
+def test_every_feature_block_validates_alone(name):
+    assert lattice._validate(compose_combo((name,))) is None
+
+
+def _fec_fields():
+    from edgellm_tpu.codecs.fec import FECConfig
+
+    return sorted(f.name for f in dataclasses.fields(FECConfig))
+
+
+# the user-facing refusals REPRODUCING documents, with their exact text —
+# a reworded die() that forgets this table is a test failure, a reworded
+# die() that forgets PAIR_ORACLE is an LL-compat finding
+_DOC_COMBOS = [
+    ("spec+batching",
+     compose_combo(("batching", "speculative")),
+     "speculative runs the one-stream spec loop; the batcher's ragged step "
+     "verifies one token per slot — drop 'speculative' or 'batching'"),
+    ("cluster without batching",
+     {"experiment": "serve", "serving": {},
+      "cluster": {"num_replicas": 2}},
+     "cluster replicas each run the continuous batcher — add a 'batching' "
+     "block"),
+    ("disagg+speculative",
+     {"experiment": "serve", "serving": {},
+      "cuts": [2], "hop_codecs": ["int8_per_token"],
+      "speculative": {"k": 4}, "disagg": {"num_prefill_workers": 1}},
+     "disagg + speculative: the spec loop is single-stream with no "
+     "prefill/decode split story — drop one of the two blocks"),
+    ("nested fec in disagg, unknown field",
+     {"experiment": "serve", "serving": {},
+      "batching": {"page_size": 8, "num_pages": 10, "max_slots": 2,
+                   "pages_per_slot": 2},
+      "disagg": {"fec": {"bogus": 1}}},
+     f"disagg.fec: unknown field(s) ['bogus']; known: {_fec_fields()}"),
+]
+
+
+@pytest.mark.parametrize("label,params,message",
+                         _DOC_COMBOS, ids=[c[0] for c in _DOC_COMBOS])
+def test_documented_invalid_combos_exact_errors(label, params, message):
+    assert lattice._validate(params) == message
+
+
+def test_budget_block_validation():
+    base = {"experiment": "serve", "serving": {}}
+
+    def msg(budget):
+        return lattice._validate({**base, "budget": budget})
+
+    assert msg({"aot_peak_bytes": 1}) is None
+    assert msg({"aot_peak_bytes": 1, "note": "why"}) is None
+    assert "must be an object" in msg([1])
+    assert "unknown field(s) ['typo']" in msg({"aot_peak_bytes": 1,
+                                              "typo": 0})
+    assert "needs 'aot_peak_bytes'" in msg({"note": "empty"})
+    assert "positive integer" in msg({"aot_peak_bytes": 0})
+    assert "positive integer" in msg({"aot_peak_bytes": True})
+    assert "must be a string" in msg({"aot_peak_bytes": 1, "note": 3})
+
+
+# ---------------------------------------------------------------------------
+# shipped configs: all valid, all budgeted, README in sync
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("path", sorted(CONFIGS.glob("*.json")),
+                         ids=lambda p: p.stem)
+def test_shipped_config_validates_with_budget(path):
+    p = json.loads(path.read_text())
+    assert lattice._validate(p) is None
+    assert p["budget"]["aot_peak_bytes"] > 0
+
+
+def test_shipped_readme_in_sync():
+    assert readme_parity_findings(CONFIGS) == []
+
+
+def test_readme_parity_catches_seeded_drift(tmp_path):
+    (tmp_path / "a.json").write_text("{}")
+    (tmp_path / "b.json").write_text("{}")
+    (tmp_path / "README.md").write_text(
+        "| config | target |\n|---|---|\n"
+        "| `a.json` | real, produces `artifact.json` |\n"
+        "| `ghost.json` | deleted config, stale row |\n")
+    findings = readme_parity_findings(tmp_path)
+    assert _rules(findings) == [lattice.RULE_README, lattice.RULE_README]
+    assert findings[0].message == "configs/b.json has no README table row"
+    assert findings[1].message == ("README mentions `ghost.json` but "
+                                   "configs/ghost.json does not exist")
+    # `artifact.json` in the description cell is NOT a registration: only
+    # the first column names configs (the relevance row mentions its
+    # produced attention_head_weights.json the same way)
+
+
+# ---------------------------------------------------------------------------
+# seeded missing donation: exactly one LL-donate finding
+# ---------------------------------------------------------------------------
+
+
+def test_donation_finding_on_stripped_donate_argnums():
+    import jax
+    import jax.numpy as jnp
+
+    def step(cache, tok):
+        return cache.at[0].add(tok), tok * 2
+
+    args = (jnp.zeros((4, 4)), jnp.ones((4,)))
+    donated = jax.jit(step, donate_argnums=(0,))
+    assert donation_findings(donated, args, 1, "fixture") == []
+
+    stripped = jax.jit(step)  # the seeded bug: donate_argnums dropped
+    findings = donation_findings(stripped, args, 1, "fixture")
+    assert _rules(findings) == [lattice.RULE_DONATE]
+    assert "donates 0 input buffer(s), needs >= 1" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# seeded budget drift: one finding per bad config, clean twin stays clean
+# ---------------------------------------------------------------------------
+
+
+def _sweep_fixture(tmp_path, budgets):
+    """A tiny token-sweep config per (name -> budget block or None), plus a
+    README that keeps parity quiet. All share one plan geometry, so the
+    lattice compiles the sweep entry points once."""
+    rows = ""
+    for name, budget in budgets.items():
+        p = {"ratios": [0], "layers_of_interest": [1],
+             "methods": ["regular_importance"], "max_length": 64,
+             "stride": 32}
+        if budget is not None:
+            p["budget"] = budget
+        (tmp_path / f"{name}.json").write_text(json.dumps(p))
+        rows += f"| `{name}.json` | fixture |\n"
+    (tmp_path / "README.md").write_text(
+        "| config | target |\n|---|---|\n" + rows)
+    return tmp_path
+
+
+def test_budget_fixtures_each_one_finding(tmp_path):
+    configs_dir = _sweep_fixture(tmp_path, {
+        "clean": {"aot_peak_bytes": 1 << 24},
+        "over": {"aot_peak_bytes": 1},   # seeded: peak can't fit in 1 byte
+        "nobudget": None,                # seeded: block missing entirely
+    })
+    findings, checked, _, matrix = run_lattice_checks(
+        configs_dir=configs_dir, pairwise=False)
+    by_stem = {pathlib.Path(f.where).stem: f for f in findings}
+    assert set(by_stem) == {"over", "nobudget"}
+    assert _rules(findings) == [lattice.RULE_BUDGET, lattice.RULE_BUDGET]
+    assert "exceeds the config's budget of 1 bytes" in by_stem[
+        "over"].message
+    assert 'missing "budget" block' in by_stem["nobudget"].message
+    assert "lattice.config:clean" in checked
+    assert "lattice.readme-parity" in checked
+
+    # the matrix records the measured peak either way
+    over = matrix["configs"]["over"]
+    assert over["peak_bytes"] > 1 and over["budget_bytes"] == 1
+    assert matrix["configs"]["clean"]["peak_bytes"] == over["peak_bytes"]
+    assert matrix["configs"]["nobudget"]["budget_bytes"] is None
+
+    # matrix shape: the documented v1 schema
+    assert matrix["schema"] == MATRIX_SCHEMA
+    assert set(matrix) == {"schema", "tiny_geometry", "configs", "pairs"}
+    geo = matrix["tiny_geometry"]
+    assert geo["model"] == "qwen2-tiny" and geo["batch"] == 1
+    for rec in matrix["configs"].values():
+        assert set(rec) == {"features", "experiment", "valid", "refusal",
+                            "entrypoints", "donation", "notes",
+                            "peak_bytes", "budget_bytes"}
+        assert rec["valid"] and rec["refusal"] is None
+        for cost in rec["entrypoints"].values():
+            assert cost["total_bytes"] == (cost["argument_bytes"]
+                                           + cost["output_bytes"]
+                                           + cost["temp_bytes"])
+
+    out = tmp_path / "matrix.json"
+    write_matrix(matrix, str(out))
+    assert json.loads(out.read_text()) == json.loads(
+        json.dumps(matrix))  # write_matrix round-trips losslessly
+
+
+# ---------------------------------------------------------------------------
+# seeded validator/oracle drift: exactly one LL-compat finding per direction
+# ---------------------------------------------------------------------------
+
+
+class _NoLowerWorld:
+    """Stand-in world for validation-only drift tests: accepted combos are
+    not lowered (the build half of the drift check runs in the slow CLI
+    acceptance test over the real world)."""
+
+    def plan(self, p):
+        return [], []
+
+
+def _drift_findings(monkeypatch, oracle, blocks=("pipeline", "speculative")):
+    monkeypatch.setattr(lattice, "FUZZ_BLOCKS",
+                        {k: lattice.FUZZ_BLOCKS[k]
+                         for k in (*blocks, "cuts")})
+    findings = []
+    lattice._pair_sweep(_NoLowerWorld(), findings, oracle)
+    return findings
+
+
+def test_drift_stale_oracle_message(monkeypatch):
+    stale = {("pipeline", "speculative"): "stale text run.py never emits"}
+    findings = _drift_findings(monkeypatch, stale)
+    assert _rules(findings) == [lattice.RULE_COMPAT]
+    assert "refused with a different message" in findings[0].message
+
+
+def test_drift_validator_refuses_unpinned_pair(monkeypatch):
+    findings = _drift_findings(monkeypatch, {})  # oracle lost the entry
+    assert _rules(findings) == [lattice.RULE_COMPAT]
+    assert ("combo pipeline+speculative should validate but run.py "
+            "refuses it" in findings[0].message)
+
+
+def test_drift_validator_accepts_pinned_pair(monkeypatch):
+    oracle = {("cuts", "pipeline"): "pinned but the check was deleted",
+              ("pipeline", "speculative"):
+                  PAIR_ORACLE[("pipeline", "speculative")]}
+    findings = _drift_findings(monkeypatch, oracle)
+    assert _rules(findings) == [lattice.RULE_COMPAT]
+    assert ("combo cuts+pipeline should be refused" in findings[0].message
+            and "but run.py accepts it" in findings[0].message)
+
+
+def test_drift_clean_oracle_no_findings(monkeypatch):
+    oracle = {("pipeline", "speculative"):
+              PAIR_ORACLE[("pipeline", "speculative")]}
+    assert _drift_findings(monkeypatch, oracle) == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(*args, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "edgellm_tpu.lint", *args],
+        capture_output=True, text=True, timeout=kw.pop("timeout", 300),
+        env=env, cwd=str(REPO))
+
+
+def test_cli_lattice_only_is_exclusive():
+    proc = _run_cli("--lattice-only", "--ast-only")
+    assert proc.returncode == 2
+    assert "mutually exclusive" in proc.stderr
+
+
+def test_cli_lattice_only_refuses_paths():
+    proc = _run_cli("--lattice-only", "edgellm_tpu/run.py")
+    assert proc.returncode == 2
+    assert "lints configs/" in proc.stderr
+
+
+@pytest.mark.slow
+def test_cli_lattice_only_clean_on_real_configs(tmp_path):
+    """Acceptance: the lattice layer alone exits 0 over the shipped configs
+    and emits the full report/SARIF/matrix artifact set — the exact command
+    CI's latticelint job gates on."""
+    report = tmp_path / "report.json"
+    sarif = tmp_path / "lattice.sarif"
+    matrix = tmp_path / "capability_matrix.json"
+    proc = _run_cli("--lattice-only", "--json", str(report),
+                    "--sarif", str(sarif), "--matrix", str(matrix),
+                    timeout=580)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    rep = json.loads(report.read_text())
+    n_configs = len(list(CONFIGS.glob("*.json")))
+    assert rep["ok"]
+    covered = [c for c in rep["checked_contracts"]
+               if c.startswith("lattice.config:")]
+    assert len(covered) == n_configs
+    assert "lattice.readme-parity" in rep["checked_contracts"]
+    assert "lattice.pairwise-compat" in rep["checked_contracts"]
+
+    doc = json.loads(sarif.read_text())
+    assert doc["version"] == "2.1.0"
+    assert doc["runs"][0]["results"] == []
+
+    m = json.loads(matrix.read_text())
+    assert m["schema"] == MATRIX_SCHEMA
+    assert len(m["configs"]) == n_configs
+    assert all(rec["valid"] for rec in m["configs"].values())
+    assert all(rec["budget_bytes"] and rec["peak_bytes"]
+               <= rec["budget_bytes"] for rec in m["configs"].values()
+               if rec["peak_bytes"] is not None)
+    # every refused fuzz pair carries run.py's exact message
+    refused = {k: v["refusal"] for k, v in m["pairs"].items()
+               if not v["ok"]}
+    for (a, b), msg in PAIR_ORACLE.items():
+        assert refused[f"{a}+{b}"] == msg
