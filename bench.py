@@ -134,6 +134,20 @@ BENCH_CLUSTER_OUTAGE_S (default 10), BENCH_CLUSTER_REAL (0 skips the
 real-model leg), BENCH_CLUSTER_REAL_REQUESTS (default 12), plus the
 shared BENCH_MODEL / BENCH_DTYPE.
 
+BENCH_GRAY=1 switches to the gray-failure acceptance surface (see
+``gray_main``): a 3-replica simulated fleet where one replica silently
+degrades 20x mid-run (after prefix affinity has captured most groups onto
+it), run with the gray plane armed (straggler demotion + latency-quantile
+hedging + deadline propagation), disabled, and with no slowdown. Gates:
+hedged SLO goodput >= 1.5x the unhedged slowed fleet and >= 0.9x the
+no-slowdown fleet, hedge overhead <= max_hedge_fraction, token identity on
+every completed request, zero accepted loss / FAILED outcomes. Knobs:
+BENCH_GRAY_REQUESTS (default 600), BENCH_GRAY_RATE (virtual arrivals/s,
+default 30), BENCH_GRAY_SEED, BENCH_GRAY_REPLICAS (default 3),
+BENCH_GRAY_SLOW_MULT (default 20), BENCH_GRAY_SLOW_AT (arrival fraction
+where the slowdown fires, default 0.3), BENCH_GRAY_DEADLINE_S (default
+0.5).
+
 BENCH_DISAGG=1 switches to the disaggregated prefill/decode acceptance
 surface (see ``disagg_main``), two legs in one section. Leg (a), perf: a
 mixed long/short Poisson workload (half greedy, half sampled via recorded
@@ -2347,6 +2361,106 @@ def cluster_main():
         raise SystemExit(f"cluster bench gates failed: {failed}")
 
 
+def gray_main():
+    """BENCH_GRAY=1: gray-failure acceptance — a 3-replica simulated fleet
+    where one replica silently degrades 20x MID-RUN (after the prefix-
+    affinity map has captured most groups onto it, the case queue-depth
+    routing cannot dodge), run three ways: gray plane armed (straggler
+    demotion + hedging + deadline propagation), gray disabled, and a
+    no-slowdown control of the same arrival plan.
+
+    Gates carried in the headline line: hedged-fleet SLO goodput >= 1.5x
+    the unhedged slowed fleet AND >= 0.9x the no-slowdown fleet, hedge
+    overhead bounded by max_hedge_fraction, token identity on every
+    completed request of the hedged run, zero accepted loss, and zero
+    FAILED outcomes. SLO goodput is deadlines-met / ALL requests — a
+    timed-out request counts as a miss instead of escaping the
+    attainment denominator."""
+    import dataclasses
+
+    from edgellm_tpu.obs.metrics import record_cluster_stats
+    from edgellm_tpu.serve.cluster import (ClusterConfig, ClusterFront,
+                                           GrayConfig, SimReplicaConfig,
+                                           SimReplicaFront)
+    from edgellm_tpu.serve.soak import ClusterSoakConfig, run_cluster_soak
+    from edgellm_tpu.utils.clock import FakeClock
+
+    n = int(os.environ.get("BENCH_GRAY_REQUESTS", "600"))
+    rate = float(os.environ.get("BENCH_GRAY_RATE", "30.0"))
+    seed = int(os.environ.get("BENCH_GRAY_SEED", "7"))
+    replicas = int(os.environ.get("BENCH_GRAY_REPLICAS", "3"))
+    slow_mult = float(os.environ.get("BENCH_GRAY_SLOW_MULT", "20.0"))
+    slow_at = float(os.environ.get("BENCH_GRAY_SLOW_AT", "0.3"))
+    deadline_s = float(os.environ.get("BENCH_GRAY_DEADLINE_S", "0.5"))
+
+    armed = GrayConfig(enabled=True, min_dwell_s=0.5, min_samples=8,
+                       window_s=30.0, max_hedge_fraction=0.4)
+    slowdowns = ((slow_at, 0, slow_mult),)
+
+    def run(gray: GrayConfig, slow: tuple, tag: str) -> tuple:
+        clock = FakeClock()
+        # deadline propagation rides the gray switch: the disabled control
+        # is the PR-19 fleet bit-for-bit
+        scfg = SimReplicaConfig(deadline_propagation=gray.enabled)
+        cluster = ClusterFront(
+            lambda rid, gen: SimReplicaFront(scfg, clock=clock,
+                                             replica_id=rid),
+            ClusterConfig(num_replicas=replicas, gray=gray), clock=clock)
+        art = run_cluster_soak(cluster, ClusterSoakConfig(
+            n_requests=n, arrival_rate=rate, seed=seed,
+            deadline_s=deadline_s, slowdowns=slow), clock=clock)
+        art["pending"] = cluster.pending
+        return art, cluster
+
+    hedged, hedged_cl = run(armed, slowdowns, "hedged")
+    unhedged, _ = run(GrayConfig(), slowdowns, "unhedged")
+    nofault, _ = run(GrayConfig(), (), "nofault")
+    record_cluster_stats(hedged["report"])
+
+    vs_unhedged = (hedged["slo_goodput"]
+                   / max(unhedged["slo_goodput"], 1e-9))
+    vs_nofault = hedged["slo_goodput"] / max(nofault["slo_goodput"], 1e-9)
+    identity = hedged["token_identity"]
+    gates = {
+        "slo_ge_1p5x_unhedged": vs_unhedged >= 1.5,
+        "slo_ge_0p9x_nofault": vs_nofault >= 0.9,
+        "hedge_fraction_bounded":
+            hedged["hedge_fraction"] <= armed.max_hedge_fraction,
+        "token_identity_ok": bool(identity["ok"] and identity["checked"]),
+        "zero_accepted_loss": (sum(hedged["outcomes"].values()) == n
+                               and hedged["pending"] == 0),
+        "zero_failed": hedged["outcomes"].get("failed", 0) == 0,
+    }
+    detail = {
+        "hedged": hedged, "unhedged": unhedged, "nofault": nofault,
+        "gray_config": dataclasses.asdict(armed),
+        "slowdowns": list(slowdowns), "gates": gates,
+    }
+    line = {
+        "metric": (f"{replicas}-replica gray-failure soak SLO goodput "
+                   f"({n} reqs at {rate}/s virtual, replica 0 slowed "
+                   f"{slow_mult}x at {slow_at:.0%} of arrivals)"),
+        "value": round(hedged["slo_goodput"], 4),
+        "unit": "SLO goodput (deadlines met / all requests)",
+        "vs_unhedged": round(vs_unhedged, 4),
+        "vs_nofault": round(vs_nofault, 4),
+        "unhedged_slo_goodput": round(unhedged["slo_goodput"], 4),
+        "nofault_slo_goodput": round(nofault["slo_goodput"], 4),
+        "hedges": hedged["hedges"],
+        "hedge_wins": hedged["hedge_wins"],
+        "hedge_fraction": round(hedged["hedge_fraction"], 4),
+        "deadline_expired": hedged["deadline_expired"],
+        "stragglers_flagged": (hedged["gray"] or {}).get("flagged"),
+        "token_identity_ok": gates["token_identity_ok"],
+        "identity_checked": identity["checked"],
+        "gates_ok": all(gates.values()),
+    }
+    _emit(line, detail)
+    if not all(gates.values()):
+        failed = sorted(k for k, v in gates.items() if not v)
+        raise SystemExit(f"gray bench gates failed: {failed}")
+
+
 def disagg_main():
     """BENCH_DISAGG=1: disaggregated prefill/decode acceptance — a mixed
     long/short Poisson workload served by the DisaggServer vs the colocated
@@ -2597,6 +2711,8 @@ def main():
         return _run_section("soak", soak_main)
     if os.environ.get("BENCH_CLUSTER") == "1":
         return _run_section("cluster", cluster_main)
+    if os.environ.get("BENCH_GRAY") == "1":
+        return _run_section("gray", gray_main)
     if os.environ.get("BENCH_DISAGG") == "1":
         return _run_section("disagg", disagg_main)
     if os.environ.get("BENCH_SERVE") == "1":

@@ -516,6 +516,88 @@ def run_graph_checks() -> Tuple[List[Finding], List[str], List[str]]:
     except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
         findings.append(_driver_error("disagg.migration-wire-bytes", e))
 
+    # ---- gray-failure hedging: pure host-side orchestration. A cluster
+    # ---- whose gray plane REALLY fired (straggler samples observed, a
+    # ---- hedge leg dispatched and settled first-finisher-wins) must leave
+    # ---- every replica batcher feeding the byte-identical ragged step
+    # ---- graph as the zero-table trace — hedging re-places REQUESTS,
+    # ---- never touches the compiled decode graph ------------------------
+    try:
+        from ..serve.cluster import ClusterConfig, ClusterFront, GrayConfig
+        from ..serve.frontend import Request, ServeFront
+        from ..serve.overload import COMPLETED
+        from ..utils.clock import FakeClock
+
+        hck = FakeClock()
+        hfronts = {}
+
+        def _hedge_factory(rid, gen):
+            f = ServeFront(cfg, params, clock=hck,
+                           batcher=batching.ContinuousBatcher(
+                               cfg, params, batching.BatchingConfig(
+                                   page_size=PGS, num_pages=NPG,
+                                   max_slots=MS, pages_per_slot=PPS)))
+            hfronts[rid] = f
+            return f
+
+        hclu = ClusterFront(_hedge_factory, ClusterConfig(
+            num_replicas=2, probe_prefix=False,
+            gray=GrayConfig(enabled=True, p95_multiple=1.5,
+                            hedge_delay_quantile=0.5, min_dwell_s=0.0,
+                            max_hedge_fraction=1.0, min_samples=1)),
+            clock=hck)
+        hprompt = np.arange(1, 1 + SEQ, dtype=np.int32)
+        # two seed requests give the detector per-replica latency samples
+        # (FakeClock latencies are 0, so the hedge delay collapses to 0)
+        for i in range(2):
+            hclu.submit(Request(prompt_ids=hprompt, max_new_tokens=3,
+                                temperature=0.0, rng_seed=i))
+            while hclu.drain():
+                pass
+        hcrid = hclu.submit(Request(prompt_ids=hprompt, max_new_tokens=3,
+                                    temperature=0.0, rng_seed=7))
+        hck.advance(0.5)   # older than the 0-second hedge delay
+        hrecs = []
+        while True:
+            got = hclu.drain()
+            if not got:
+                break
+            hrecs.extend(got)
+        if hclu.totals["hedges"] < 1:
+            raise AssertionError("driver bug: no hedge leg fired")
+        if hclu.pending:
+            raise AssertionError(
+                f"hedge settlement lost work: {hclu.pending} pending")
+        hrec = next(r for r in hrecs if r.request_id == hcrid)
+        href = np.asarray(serve_decode.generate(
+            cfg, params, hprompt[None], 3, capacity=CAPACITY,
+            rng_key=jax.random.key(7)))[0]
+        htoks_got = (None if hrec.tokens is None
+                     else np.asarray(hrec.tokens).reshape(-1))
+        if hrec.outcome != COMPLETED or not np.array_equal(htoks_got, href):
+            findings.append(Finding(
+                layer="graph", rule="GC-identity",
+                where="cluster.hedge-disabled-identity", line=0,
+                message=f"hedged request diverged from direct generate: "
+                        f"outcome={hrec.outcome} tokens={htoks_got} "
+                        f"!= {href.tolist()}"))
+        else:
+            hpool = hfronts[0].batcher.pool
+            htab, hlens = hpool.device_tables()
+            htoks = jnp.zeros((MS,), jnp.int32)
+            ident = check_identity(
+                "cluster.hedge-disabled-identity",
+                lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                    cfg, p, pk, pv, pt, ln, t),
+                (params, hpool.pool.k, hpool.pool.v, htab, hlens, htoks),
+                lambda p, pk, pv, pt, ln, t: paged_kv.paged_decode_step(
+                    cfg, p, pk, pv, pt, ln, t),
+                (params, ppool.k, ppool.v, ptab, plens, ptoks),
+                what="gray-hedged replica's ragged decode-step graph")
+            (findings.extend(ident) if ident
+             else checked.append("cluster.hedge-disabled-identity"))
+    except Exception as e:  # noqa: BLE001 — a crashed driver must be loud
+        findings.append(_driver_error("cluster.hedge-disabled-identity", e))
 
     # ---- split pipeline: boundary hops over a real 2-stage mesh ---------
     if len(jax.devices()) < 2:

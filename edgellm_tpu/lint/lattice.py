@@ -21,7 +21,7 @@ Everything is static: ``.lower()`` traces, ``.compile()`` builds the
 executable, ``memory_analysis()`` is a read — no model math executes and
 no device memory is allocated (the same property that makes the preflight
 safe on the tunneled TPU backend). The whole sweep shares one compile
-cache keyed by plan geometry, so the 26 configs plus ~80 fuzzed combos
+cache keyed by plan geometry, so the 27 configs plus ~80 fuzzed combos
 resolve to a couple dozen distinct compiles.
 
 The machine-readable side product is ``capability_matrix.json``
@@ -89,6 +89,7 @@ PAIR_ORACLE: Dict[Tuple[str, str], str] = {
     ("batching", "speculative"): _MSG_SPEC_BATCH,
     ("cluster", "speculative"): _MSG_SPEC_BATCH,
     ("disagg", "speculative"): _MSG_SPEC_BATCH,
+    ("gray", "speculative"): _MSG_SPEC_BATCH,
     ("kv_at_rest", "speculative"): _MSG_SPEC_BATCH,
     ("prefix_cache", "speculative"): _MSG_SPEC_BATCH,
     ("faults", "fused_hops"): _MSG_FUSED_LINK,
@@ -113,6 +114,7 @@ FUZZ_BLOCKS: Dict[str, dict] = {
     "kv_at_rest": {"kv_at_rest": {"codec": "int8_per_channel"}},
     "cluster": {"cluster": {"num_replicas": 2}},
     "disagg": {"disagg": {"num_prefill_workers": 1}},
+    "gray": {"gray": {"p95_multiple": 3.0}},
 }
 
 #: structural prerequisites a feature block cannot validate without —
@@ -123,6 +125,9 @@ FUZZ_DEPS: Dict[str, Tuple[str, ...]] = {
     "pipeline": ("cuts",), "speculative": ("cuts",), "fused_hops": ("cuts",),
     "prefix_cache": ("batching",), "kv_at_rest": ("batching",),
     "cluster": ("batching",), "disagg": ("batching",),
+    # dep expansion is one level deep, so gray names cluster's own
+    # scaffolding explicitly
+    "gray": ("cluster", "batching"),
 }
 
 FUZZ_BASE = {"experiment": "serve", "serving": {}}
@@ -131,7 +136,7 @@ FUZZ_BASE = {"experiment": "serve", "serving": {}}
 FEATURE_KEYS = (
     "cuts", "faults", "link_policy", "fec", "hedge", "link_health",
     "fused_hops", "pipeline", "speculative", "serving", "batching",
-    "prefix_cache", "kv_at_rest", "cluster", "disagg", "deadline",
+    "prefix_cache", "kv_at_rest", "cluster", "disagg", "gray", "deadline",
     "stage_failure", "recovery", "n_seq")
 
 
@@ -677,7 +682,7 @@ class _Lattice:
                     entries += self.entry_prefill_suffix()
             else:
                 entries += self.entry_decode()
-            for host_side in ("cluster", "disagg"):
+            for host_side in ("cluster", "disagg", "gray"):
                 if host_side in p:
                     notes.append(f"{host_side} is host-side orchestration: "
                                  f"its replicas/workers compile the entry "

@@ -89,6 +89,14 @@ METRIC_NAMES: FrozenSet[str] = frozenset({
     "edgellm_disagg_prefill_workers",
     "edgellm_disagg_queue_depth",
     "edgellm_disagg_degraded",
+    # gray-failure plane (serve/overload.py StragglerDetector +
+    # serve/cluster.py hedging + deadline propagation)
+    "edgellm_gray_stragglers",
+    "edgellm_gray_hedge_delay_s",
+    "edgellm_gray_hedges_total",
+    "edgellm_gray_hedge_wins_total",
+    "edgellm_gray_deadline_expired_total",
+    "edgellm_gray_demotions_total",
 })
 
 #: templates for adapter families whose middle segment is a runtime key
@@ -149,6 +157,11 @@ SPAN_NAMES: FrozenSet[str] = frozenset({
     "disagg.degrade",
     "disagg.kill",
     "disagg.readmit",
+    # gray-failure plane: hedges and straggler verdict flips are rare by
+    # construction (bounded by max_hedge_fraction / dwell hysteresis), so
+    # spanning them keeps the per-request hot path span-free
+    "cluster.hedge",
+    "gray.demote",
 })
 
 #: span-name templates (none yet — span names are all static today); kept so

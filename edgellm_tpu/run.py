@@ -121,6 +121,7 @@ _PARAM_KEYS = {
     "speculative": "serve",
     "cluster": "serve",
     "disagg": "serve",
+    "gray": "serve",
     "max_compiles": "distances",
     "observability": "all",
     "budget": "all (latticelint AOT peak)",
@@ -621,6 +622,25 @@ def _validate_params_json(p: dict) -> None:
             _disagg_config(dg)
         except (TypeError, ValueError) as e:
             die(f"disagg: {e}")
+    if "gray" in p:
+        from .serve.cluster import GrayConfig
+
+        if exp != "serve":
+            die("gray only applies to experiment 'serve'")
+        if "cluster" not in p:
+            die("gray hardening (straggler demotion, request hedging) is a "
+                "router policy — add a 'cluster' block")
+        gy = p["gray"]
+        if not isinstance(gy, dict):
+            die(f"gray must be an object of GrayConfig fields, got {gy!r}")
+        top = {f.name for f in dataclasses.fields(GrayConfig)}
+        bad = sorted(set(gy) - top)
+        if bad:
+            die(f"gray: unknown field(s) {bad}; known: {sorted(top)}")
+        try:
+            _gray_config(gy)
+        except (TypeError, ValueError) as e:
+            die(f"gray: {e}")
 
 
 def _pipeline_config(p: dict):
@@ -689,6 +709,21 @@ def _disagg_config(dg: dict):
         if kwargs.get(key) is not None:
             kwargs[key] = cls(**kwargs[key])
     return DisaggConfig(**kwargs)
+
+
+def _gray_config(gy: dict):
+    """Build the :class:`GrayConfig` a ``"gray"`` params block describes —
+    flat scalar fields only (the straggler/hedge thresholds). Raises
+    ``TypeError``/``ValueError``/``ClusterConfigError`` on bad fields; the
+    validator turns those into field-naming ``die()``s. A params block that
+    is present but does not say otherwise is armed: configs opt in by
+    writing the block at all, so ``enabled`` defaults to True here (the
+    dataclass default False serves programmatic construction)."""
+    from .serve.cluster import GrayConfig
+
+    kwargs = dict(gy)
+    kwargs.setdefault("enabled", True)
+    return GrayConfig(**kwargs)
 
 
 def _attach_front_obs(front) -> None:
@@ -1163,7 +1198,7 @@ def main(argv=None) -> int:
                         from .serve.disagg import DisaggServer
 
                         return DisaggServer(cfg, params, bcfg, dcfg,
-                                            **split_kw)
+                                            clock=clock, **split_kw)
                     return ContinuousBatcher(cfg, params, bcfg, **split_kw)
 
                 if "cluster" in params_json:
@@ -1176,6 +1211,10 @@ def main(argv=None) -> int:
                     from .serve.frontend import Request
 
                     ccfg = _cluster_config(params_json["cluster"])
+                    if "gray" in params_json:
+                        ccfg = dataclasses.replace(
+                            ccfg,
+                            gray=_gray_config(params_json["gray"]))
 
                     def replica_factory(replica_id, generation):
                         return ServeFront(cfg, params, config=front_cfg,

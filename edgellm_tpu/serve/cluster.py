@@ -64,14 +64,17 @@ from ..utils.clock import MONOTONIC, Clock
 from ..utils.concurrency import guarded_by
 from .frontend import Request, RequestRecord
 from .overload import (COMPLETED, FAILED, FAILED_OVER, REJECTED, SHED,
-                       TIMED_OUT, BreakerConfig, CircuitBreaker, RetryBudget,
-                       RetryBudgetConfig, ServeFrontConfigError)
+                       TIMED_OUT, BreakerConfig, CircuitBreaker,
+                       DeadlineExpired, RetryBudget, RetryBudgetConfig,
+                       ServeFrontConfigError, StragglerConfig,
+                       StragglerDetector)
 from .recovery import DecodeCheckpoint
 
 __all__ = [
     "AutoscalerConfig", "ClusterConfig", "ClusterConfigError", "ClusterFront",
-    "Replica", "ReplicaLostError", "RespawnConfig", "SimReplicaConfig",
-    "SimReplicaFront", "drive_cluster", "sim_reference_tokens",
+    "GrayConfig", "Replica", "ReplicaLostError", "RespawnConfig",
+    "SimReplicaConfig", "SimReplicaFront", "drive_cluster",
+    "sim_reference_tokens",
     "REPLICA_LIVE", "REPLICA_DEAD", "REPLICA_PROBING",
 ]
 
@@ -162,6 +165,54 @@ class AutoscalerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class GrayConfig:
+    """The gray-failure plane's policy bundle: straggler demotion, request
+    hedging, and deadline propagation. A replica whose windowed p95 service
+    latency reaches ``p95_multiple`` × the pooled fleet median is demoted
+    in the placement sort (it still serves, but loses every tie); a request
+    still running after the fleet's ``hedge_delay_quantile`` latency is
+    re-placed on a second replica and the first finisher wins, with the
+    loser cancelled or discarded exactly-once. ``max_hedge_fraction``
+    bounds hedge dispatches relative to primary placements so the backup
+    traffic cannot itself brown the fleet out."""
+
+    enabled: bool = False
+    p95_multiple: float = 3.0
+    hedge_delay_quantile: float = 0.95
+    min_dwell_s: float = 5.0
+    max_hedge_fraction: float = 0.25
+    min_samples: int = 8
+    window_s: float = 120.0
+
+    def __post_init__(self):
+        if not isinstance(self.enabled, bool):
+            raise ClusterConfigError(
+                f"enabled must be a bool, got {self.enabled!r}")
+        if self.p95_multiple <= 1.0:
+            raise ClusterConfigError(
+                f"p95_multiple must be > 1, got {self.p95_multiple!r}")
+        if not 0.0 < self.hedge_delay_quantile < 1.0:
+            raise ClusterConfigError(
+                f"hedge_delay_quantile must be in (0, 1), got "
+                f"{self.hedge_delay_quantile!r}")
+        if self.min_dwell_s < 0:
+            raise ClusterConfigError(
+                f"min_dwell_s must be >= 0, got {self.min_dwell_s!r}")
+        if not 0.0 <= self.max_hedge_fraction <= 1.0:
+            raise ClusterConfigError(
+                f"max_hedge_fraction must be in [0, 1], got "
+                f"{self.max_hedge_fraction!r}")
+        if isinstance(self.min_samples, bool) or not isinstance(
+                self.min_samples, int) or self.min_samples < 1:
+            raise ClusterConfigError(
+                f"min_samples must be an int >= 1, got "
+                f"{self.min_samples!r}")
+        if self.window_s <= 0:
+            raise ClusterConfigError(
+                f"window_s must be > 0, got {self.window_s!r}")
+
+
+@dataclasses.dataclass(frozen=True)
 class ClusterConfig:
     """The router's frozen policy bundle. ``min_affinity_tokens`` is the
     prefix-affinity threshold (a shorter match routes least-loaded instead);
@@ -181,6 +232,7 @@ class ClusterConfig:
     respawn: RespawnConfig = dataclasses.field(default_factory=RespawnConfig)
     autoscaler: AutoscalerConfig = dataclasses.field(
         default_factory=AutoscalerConfig)
+    gray: GrayConfig = dataclasses.field(default_factory=GrayConfig)
     flight_dir: Optional[str] = None
     checkpoint_dir: Optional[str] = None
 
@@ -199,7 +251,8 @@ class ClusterConfig:
         for field, cls in (("breaker", BreakerConfig),
                            ("retry_budget", RetryBudgetConfig),
                            ("respawn", RespawnConfig),
-                           ("autoscaler", AutoscalerConfig)):
+                           ("autoscaler", AutoscalerConfig),
+                           ("gray", GrayConfig)):
             if not isinstance(getattr(self, field), cls):
                 raise ClusterConfigError(
                     f"{field} must be a {cls.__name__}, got "
@@ -270,8 +323,15 @@ class _Placement:
     replica_id: int
     local_rid: int
     submitted_at: float
+    generation: int = 0             # replica generation the leg was placed on
     resubmits: int = 0
     recompute_tokens: int = 0       # tokens regenerated after scratch readmits
+    # hedge leg (gray-failure plane): a second, concurrently running copy
+    # of the same request on another replica — first finisher wins
+    hedge_replica_id: Optional[int] = None
+    hedge_local_rid: Optional[int] = None
+    hedge_generation: Optional[int] = None
+    hedged_at: Optional[float] = None
 
 
 @guarded_by("_lock", fields=["_seq", "_loose"])
@@ -309,7 +369,24 @@ class ClusterFront:
         self.autoscale_events: list = []
         self.totals = {"placed": 0, "affinity": 0, "least_loaded": 0,
                        "probe": 0, "readmitted": 0, "recompute_tokens": 0,
-                       "no_replica_rejects": 0, "parked_total": 0}
+                       "no_replica_rejects": 0, "parked_total": 0,
+                       "hedges": 0, "hedge_wins_primary": 0,
+                       "hedge_wins_hedge": 0, "hedge_cancelled": 0,
+                       "hedge_discarded": 0, "hedge_refused": 0,
+                       "deadline_expired": 0}
+        gray = self.cfg.gray
+        self._straggler: Optional[StragglerDetector] = (
+            StragglerDetector(
+                StragglerConfig(p95_multiple=gray.p95_multiple,
+                                window_s=gray.window_s,
+                                min_samples=gray.min_samples,
+                                min_dwell_s=gray.min_dwell_s),
+                clock=self.clock)
+            if gray.enabled else None)
+        self._gray_flagged: set = set()
+        # losing hedge legs whose front could not cancel them: their late
+        # records are swallowed on arrival (exactly-once accounting)
+        self._hedge_discard: set = set()
         for i in range(self.cfg.num_replicas):
             self.replicas[i] = self._new_replica(i)
 
@@ -373,7 +450,7 @@ class ClusterFront:
             for local_rid, req in front.drain_pending():
                 crid = self._local_index.pop(
                     (r.id, r.generation, local_rid), None)
-                if crid is not None:
+                if crid is not None and not self._detach_leg(crid, r.id):
                     self._readmit(crid, resume=None)
             # 2) mid-flight work: checkpoint via DecodeCheckpoint and resume
             #    elsewhere (or re-run from scratch, counting the tokens the
@@ -383,7 +460,7 @@ class ClusterFront:
                 for item in ckpt(self.cfg.checkpoint_dir):
                     crid = self._local_index.pop(
                         (r.id, r.generation, item["local_rid"]), None)
-                    if crid is not None:
+                    if crid is not None and not self._detach_leg(crid, r.id):
                         self._readmit(crid, resume=item)
 
     def _respawn(self, r: Replica) -> None:
@@ -414,6 +491,10 @@ class ClusterFront:
             # appending to the list under iteration would retry the same
             # request forever inside this loop
             parked, self._parked = self._parked, []
+            # starvation guard: cluster ids mint in arrival order, so the
+            # oldest parked request gets first claim on freed capacity no
+            # matter how it bounced back into the park list
+            parked.sort(key=lambda item: item[0])
             for crid, resume in parked:
                 target, _ = self._place(self._placements[crid].req)
                 if target is not None:
@@ -426,6 +507,8 @@ class ClusterFront:
                     self._parked.append((crid, resume))
                 else:
                     self._readmit_to(target, crid, resume)
+        if self._straggler is not None:
+            self._gray_tick(now)
         self._publish()
         if self.cfg.autoscaler.enabled:
             self._autoscale(now)
@@ -473,16 +556,28 @@ class ClusterFront:
                 if shared >= self.cfg.min_affinity_tokens:
                     # a degraded disagg replica still wins on a strong
                     # prefix hit (the shared KV outweighs colocated
-                    # throughput) but loses every tie to a healthy peer
-                    key = (-shared, r._disagg_penalty(),
+                    # throughput) but loses every tie to a healthy peer;
+                    # a flagged straggler is demoted the same way
+                    key = (-shared,
+                           r._disagg_penalty() + self._gray_penalty(r),
                            r.front.queue_depth, r.id)
                     if best is None or key < best[0]:
                         best = (key, r)
             if best is not None:
                 return best[1], "affinity"
-        r = min(cands, key=lambda c: (c._disagg_penalty(),
+        r = min(cands, key=lambda c: (c._disagg_penalty()
+                                      + self._gray_penalty(c),
                                       c.front.queue_depth, c.id))
         return r, "least_loaded"
+
+    def _gray_penalty(self, r: Replica) -> int:
+        """1 when the straggler detector currently flags this replica (it
+        loses every placement tie, like a degraded disagg front), else 0.
+        Zero-cost identity when the gray plane is disabled: the sort keys
+        collapse to the pre-gray ordering."""
+        if self._straggler is None:
+            return 0
+        return 1 if r.id in self._gray_flagged else 0
 
     def submit(self, req: Request) -> int:
         """Route one request onto the fleet; returns the cluster request id.
@@ -509,7 +604,7 @@ class ClusterFront:
         local_rid, refusal = self._submit_to(target, req)
         self._placements[crid] = _Placement(
             crid=crid, req=req, replica_id=target.id, local_rid=local_rid,
-            submitted_at=now)
+            submitted_at=now, generation=target.generation)
         self._local_index[(target.id, target.generation, local_rid)] = crid
         if refusal is not None:
             # replica-level admission refusal, already terminal there —
@@ -576,6 +671,15 @@ class ClusterFront:
     def _readmit_to(self, target: Replica, crid: int,
                     resume: Optional[dict]) -> None:
         pl = self._placements[crid]
+        now = self.clock()
+        remaining = self._remaining_deadline(pl, now)
+        if remaining is not None and remaining <= 0.0:
+            # deadline audit: admission checks the wait at enqueue, but a
+            # park (or a kill + backoff) can eat the whole budget before
+            # placement ever happens — finish timed_out here instead of
+            # dispatching work nobody can use
+            self._expire_placement(crid, now)
+            return
         restore = getattr(target.front, "restore_inflight", None)
         if resume is not None and restore is not None:
             # checkpointed stream resumes where it stopped: token-identical
@@ -589,18 +693,60 @@ class ClusterFront:
                 pl.recompute_tokens += int(resume.get("tokens_done", 0))
                 self.totals["recompute_tokens"] += int(
                     resume.get("tokens_done", 0))
-            local_rid, refusal = self._submit_to(target, pl.req)
+            # deadline propagation: the survivor sees only the budget that
+            # is still left, so its own admission/queue checks refuse work
+            # that can no longer finish in time
+            local_rid, refusal = self._submit_to(
+                target, self._effective_req(pl, now))
         if target.state == REPLICA_PROBING:
             target.probes_sent += 1
         target.placed += 1
         pl.replica_id = target.id
         pl.local_rid = local_rid
+        pl.generation = target.generation
         self._local_index[(target.id, target.generation, local_rid)] = crid
         if refusal is not None:
             final = self._absorb(target, refusal)
             if final is not None:
                 with self._lock:
                     self._loose.append(final)
+
+    def _remaining_deadline(self, pl: _Placement,
+                            now: float) -> Optional[float]:
+        if pl.req.deadline_s is None:
+            return None
+        return pl.req.deadline_s - (now - pl.submitted_at)
+
+    def _effective_req(self, pl: _Placement, now: float) -> Request:
+        """The request with its deadline decremented by the budget already
+        spent at this router (park→place→queue→…): what a downstream stage
+        may still burn. ``_finalize`` restores the original deadline on the
+        way out, so records always carry the caller's contract."""
+        remaining = self._remaining_deadline(pl, now)
+        if remaining is None:
+            return pl.req
+        return dataclasses.replace(pl.req, deadline_s=remaining)
+
+    def _expire_placement(self, crid: int, now: float) -> None:
+        """Finish an accepted-but-expired request as ``timed_out`` with the
+        typed ``deadline_expired`` reason (:class:`DeadlineExpired`)."""
+        pl = self._placements.pop(crid)
+        self.totals["deadline_expired"] += 1
+        rec = dataclasses.replace(
+            self._refusal_record(crid, pl.req, now),
+            outcome=TIMED_OUT, reason=DeadlineExpired.reason,
+            submitted_at=pl.submitted_at,
+            queue_wait_s=now - pl.submitted_at, deadline_met=False,
+            recovery=({"readmissions": pl.resubmits,
+                       "recompute_tokens": pl.recompute_tokens}
+                      if pl.resubmits else None))
+        reg = get_registry()
+        if reg.enabled:
+            reg.counter("edgellm_gray_deadline_expired_total",
+                        "requests refused after their deadline budget "
+                        "expired pre-dispatch").inc()
+        with self._lock:
+            self._loose.append(rec)
 
     # -- drain / absorption -------------------------------------------------
 
@@ -655,18 +801,32 @@ class ClusterFront:
         """Fold one replica-local record into router state. Returns the
         finalized cluster-level record, or None when the record was
         absorbed (a replica-fatal failure whose request re-admitted)."""
-        crid = self._local_index.pop((r.id, r.generation, rec.request_id),
-                                     None)
+        key = (r.id, r.generation, rec.request_id)
+        crid = self._local_index.pop(key, None)
         if crid is None:
+            if key in self._hedge_discard:
+                # the losing leg of a settled hedge finished late on a
+                # front without cancel support: exactly-once accounting
+                # swallows its record here, never surfacing a duplicate
+                self._hedge_discard.discard(key)
+                self.totals["hedge_discarded"] += 1
+                return None
             # not ours (e.g. a stream the replica served before adoption) —
             # surface verbatim rather than silently dropping
             return rec
         pl = self._placements[crid]
+        hedged = pl.hedge_replica_id is not None
+        from_hedge_leg = (hedged and r.id == pl.hedge_replica_id
+                          and rec.request_id == pl.hedge_local_rid)
         r.budget.charge(rec.retries_charged)
         if rec.outcome in (COMPLETED, FAILED_OVER):
             r.breaker.record_success()
             r.completed += 1
             self._probe_result(r, ok=True)
+            self._observe_latency(r, rec)
+            if hedged:
+                # first finisher wins: cancel/discard the other leg
+                self._settle_hedge(pl, winner_hedge=from_hedge_leg)
             return self._finalize(r, rec, pl)
         if rec.outcome == FAILED:
             replica_fatal = (rec.reason.startswith(_REPLICA_FATAL_PREFIXES)
@@ -677,10 +837,31 @@ class ClusterFront:
             if replica_fatal:
                 if r.state != REPLICA_DEAD:
                     self._kill(r, rec.reason)
+                # _kill's drain may already have detached/promoted legs of
+                # this placement; only readmit when no leg still covers it
+                if hedged and self._detach_leg(crid, r.id):
+                    return None
                 self._readmit(crid, resume=None)
                 return None
+            if hedged:
+                # one leg failed non-fatally; the other may still finish
+                # clean — drop this leg only (the breaker already saw it)
+                self._detach_leg(crid, r.id)
+                return None
             return self._finalize(r, rec, pl)
-        # REJECTED / SHED / TIMED_OUT
+        if rec.outcome == TIMED_OUT:
+            # legs carry decremented deadlines, so one leg expiring means
+            # the request's global budget is gone — settle the other leg
+            # and finish timed_out
+            if hedged:
+                self._settle_hedge(pl, winner_hedge=from_hedge_leg)
+            return self._finalize(r, rec, pl)
+        # REJECTED / SHED
+        if hedged:
+            # an admission refusal on one leg of a still-covered request:
+            # detach the refused leg, let the other run
+            self._detach_leg(crid, r.id)
+            return None
         if rec.outcome in (REJECTED, SHED) and pl.resubmits > 0:
             # a survivor's admission control refused re-admitted work: park
             # and retry later — accepted work is never lost to a refusal
@@ -688,6 +869,155 @@ class ClusterFront:
             self._parked.append((crid, None))
             return None
         return self._finalize(r, rec, pl)
+
+    # -- the gray-failure plane ---------------------------------------------
+
+    def _observe_latency(self, r: Replica, rec: RequestRecord) -> None:
+        """Feed one completed leg's end-to-end latency into the straggler
+        detector (keyed by replica id)."""
+        if self._straggler is None:
+            return
+        sample = rec.latency_s if rec.latency_s is not None else rec.service_s
+        if sample is not None:
+            self._straggler.observe(r.id, float(sample))
+
+    def _gray_tick(self, now: float) -> None:
+        """Refresh the straggler verdict set (spanned on every flip) and run
+        one hedge pass over still-running placements."""
+        flagged = set(self._straggler.stragglers())
+        reg = get_registry()
+        for rid in sorted(flagged - self._gray_flagged):
+            with obs_span("gray.demote", replica=rid, direction="demote"):
+                if reg.enabled:
+                    reg.counter("edgellm_gray_demotions_total",
+                                "straggler demotions (replica flagged "
+                                "slow)").inc()
+        for rid in sorted(self._gray_flagged - flagged):
+            with obs_span("gray.demote", replica=rid, direction="promote"):
+                pass
+        self._gray_flagged = flagged
+        self._hedge_tick(now)
+
+    def _hedge_tick(self, now: float) -> None:
+        """Hedge requests that have been running longer than the fleet's
+        ``hedge_delay_quantile`` latency: re-place a second copy on another
+        replica, first finisher wins. Bounded by ``max_hedge_fraction`` of
+        primary placements; silent until the detector has samples."""
+        gray = self.cfg.gray
+        delay = self._straggler.fleet_quantile(gray.hedge_delay_quantile,
+                                               exclude=self._gray_flagged)
+        if delay is None:
+            return
+        parked = {crid for crid, _ in self._parked}
+        for crid in sorted(self._placements):
+            pl = self._placements.get(crid)
+            if pl is None or pl.hedge_replica_id is not None:
+                continue
+            if crid in parked:
+                continue   # not running anywhere: a park, not a straggle
+            if now - pl.submitted_at <= delay:
+                continue
+            if (self.totals["hedges"] + 1
+                    > gray.max_hedge_fraction
+                    * max(self.totals["placed"], 1)):
+                return     # hedge budget spent for now
+            self._hedge(pl, now)
+
+    def _hedge(self, pl: _Placement, now: float) -> None:
+        remaining = self._remaining_deadline(pl, now)
+        if remaining is not None and remaining <= 0.0:
+            return   # budget already gone: the running leg times out alone
+        cands = [c for c in self._candidates()
+                 if c.state == REPLICA_LIVE and c.id != pl.replica_id
+                 and c.id not in self._gray_flagged]
+        ready = []
+        for c in cands:
+            lf = getattr(c.front, "load_fraction", None)
+            if lf is None or lf() < 1.0:
+                ready.append(c)
+        if not ready:
+            return
+        target = min(ready, key=lambda c: (c._disagg_penalty(),
+                                           c.front.queue_depth, c.id))
+        with obs_span("cluster.hedge", crid=pl.crid,
+                      primary=pl.replica_id, target=target.id):
+            local_rid, refusal = self._submit_to(
+                target, self._effective_req(pl, now))
+            if refusal is not None:
+                self.totals["hedge_refused"] += 1
+                return
+            target.placed += 1
+            pl.hedge_replica_id = target.id
+            pl.hedge_local_rid = local_rid
+            pl.hedge_generation = target.generation
+            pl.hedged_at = now
+            self._local_index[(target.id, target.generation,
+                               local_rid)] = pl.crid
+            self.totals["hedges"] += 1
+            reg = get_registry()
+            if reg.enabled:
+                reg.counter("edgellm_gray_hedges_total",
+                            "hedge legs dispatched").inc()
+
+    def _settle_hedge(self, pl: _Placement, winner_hedge: bool) -> None:
+        """One leg of a hedged placement went terminal: cancel the loser
+        where the front supports it, otherwise mark its key for discard so
+        its late record is swallowed (exactly-once)."""
+        if winner_hedge:
+            loser_key = (pl.replica_id, pl.generation, pl.local_rid)
+            self.totals["hedge_wins_hedge"] += 1
+            win_leg = "hedge"
+        else:
+            loser_key = (pl.hedge_replica_id, pl.hedge_generation,
+                         pl.hedge_local_rid)
+            self.totals["hedge_wins_primary"] += 1
+            win_leg = "primary"
+        if self._local_index.pop(loser_key, None) is not None:
+            loser = self.replicas.get(loser_key[0])
+            cancel = (getattr(loser.front, "cancel", None)
+                      if loser is not None and loser.front is not None
+                      else None)
+            if cancel is not None and cancel(loser_key[2]):
+                self.totals["hedge_cancelled"] += 1
+            else:
+                self._hedge_discard.add(loser_key)
+        if winner_hedge:
+            # promote the winning hedge leg so _finalize and any later
+            # bookkeeping see a coherent single-leg placement
+            pl.replica_id = pl.hedge_replica_id
+            pl.local_rid = pl.hedge_local_rid
+            pl.generation = pl.hedge_generation
+        pl.hedge_replica_id = None
+        pl.hedge_local_rid = None
+        pl.hedge_generation = None
+        pl.hedged_at = None
+        reg = get_registry()
+        if reg.enabled:
+            reg.counter("edgellm_gray_hedge_wins_total",
+                        "settled hedges by winning leg").inc(leg=win_leg)
+
+    def _detach_leg(self, crid: int, replica_id: int) -> bool:
+        """Drop one leg of a hedged placement (its replica died, scaled
+        away, or refused the work). Returns True when the other leg still
+        covers the request — the caller must NOT readmit. False when the
+        placement was not hedged (single-leg: normal recovery applies)."""
+        pl = self._placements.get(crid)
+        if pl is None or pl.hedge_replica_id is None:
+            return False
+        if replica_id == pl.hedge_replica_id:
+            pass                       # hedge leg lost: primary covers
+        elif replica_id == pl.replica_id:
+            # primary lost: the hedge leg is the request now
+            pl.replica_id = pl.hedge_replica_id
+            pl.local_rid = pl.hedge_local_rid
+            pl.generation = pl.hedge_generation
+        else:
+            return False
+        pl.hedge_replica_id = None
+        pl.hedge_local_rid = None
+        pl.hedge_generation = None
+        pl.hedged_at = None
+        return True
 
     def _probe_result(self, r: Replica, ok: bool) -> None:
         if r.state != REPLICA_PROBING:
@@ -715,7 +1045,10 @@ class ClusterFront:
             recovery["recompute_tokens"] = pl.recompute_tokens
         return dataclasses.replace(
             rec, request_id=pl.crid, plan=plan, recovery=recovery,
-            submitted_at=pl.submitted_at)
+            submitted_at=pl.submitted_at,
+            # a readmitted/hedged leg ran under a decremented deadline;
+            # the cluster record restores the caller's original contract
+            deadline_s=pl.req.deadline_s)
 
     # -- autoscaler ---------------------------------------------------------
 
@@ -749,6 +1082,17 @@ class ClusterFront:
         reg.gauge("edgellm_cluster_pressure",
                   "mean live-replica load fraction").set(
             self._fleet_pressure())
+        if self._straggler is not None:
+            reg.gauge("edgellm_gray_stragglers",
+                      "replicas currently flagged slow").set(
+                len(self._gray_flagged))
+            delay = self._straggler.fleet_quantile(
+                self.cfg.gray.hedge_delay_quantile,
+                exclude=self._gray_flagged)
+            if delay is not None:
+                reg.gauge("edgellm_gray_hedge_delay_s",
+                          "current hedge trigger delay (fleet latency "
+                          "quantile)").set(delay)
 
     def _autoscale(self, now: float) -> None:
         """Simulated autoscaler, driven by the published
@@ -785,7 +1129,8 @@ class ClusterFront:
                 for local_rid, req in front.drain_pending():
                     crid = self._local_index.pop(
                         (victim.id, victim.generation, local_rid), None)
-                    if crid is not None:
+                    if crid is not None and not self._detach_leg(
+                            crid, victim.id):
                         self._readmit(crid, resume=None)
                 ckpt = getattr(front, "checkpoint_inflight", None)
                 if ckpt is not None:
@@ -793,7 +1138,8 @@ class ClusterFront:
                         crid = self._local_index.pop(
                             (victim.id, victim.generation,
                              item["local_rid"]), None)
-                        if crid is not None:
+                        if crid is not None and not self._detach_leg(
+                                crid, victim.id):
                             self._readmit(crid, resume=item)
                 del self.replicas[victim.id]
                 self._last_scale_at = now
@@ -851,6 +1197,13 @@ class ClusterFront:
             "kills": list(self.kills),
             "autoscale_events": list(self.autoscale_events),
             "pressure": self._fleet_pressure(),
+            "gray": (None if self._straggler is None else {
+                "flagged": sorted(self._gray_flagged),
+                "hedge_delay_s": self._straggler.fleet_quantile(
+                    self.cfg.gray.hedge_delay_quantile,
+                    exclude=self._gray_flagged),
+                "detector": self._straggler.summary(),
+            }),
         }
         # counters in record_cluster_stats carry running totals: the
         # end-of-run consumer absorbs the final report exactly once
@@ -920,8 +1273,16 @@ class SimReplicaConfig:
     max_queue_depth: int = 64
     prefix_block: int = 4
     index_capacity: int = 50_000
+    # gray plane: refuse work whose (decremented) deadline has already
+    # passed at prefill/decode chunk boundaries instead of burning tokens.
+    # Off by default so a gray-disabled fleet behaves bit-identically.
+    deadline_propagation: bool = False
 
     def __post_init__(self):
+        if not isinstance(self.deadline_propagation, bool):
+            raise ClusterConfigError(
+                f"deadline_propagation must be a bool, got "
+                f"{self.deadline_propagation!r}")
         if self.chunk_tokens < 1:
             raise ClusterConfigError(
                 f"chunk_tokens must be >= 1, got {self.chunk_tokens!r}")
@@ -973,6 +1334,7 @@ class SimReplicaFront:
         self._busy_until: Optional[float] = None
         self._fault_reason: Optional[str] = None
         self._corrupt_rate = 0.0
+        self._service_mult = 1.0
         self._prefix_index: dict = {}   # crc(prefix block chain) -> True
         self.served = 0
 
@@ -1048,11 +1410,55 @@ class SimReplicaFront:
         ``substituted_payload`` at this seeded per-request rate."""
         self._corrupt_rate = float(rate)
 
+    def set_service_multiplier(self, mult: float) -> None:
+        """Gray-failure slowdown: stretch every subsequently *scheduled*
+        prefill/decode phase by this factor. The replica stays alive and
+        passes every health check — it is merely slow, which is the point."""
+        if mult <= 0:
+            raise ValueError(f"service multiplier must be > 0, got {mult!r}")
+        self._service_mult = float(mult)
+
+    # -- hedge support ------------------------------------------------------
+
+    def cancel(self, rid: int) -> bool:
+        """Abandon one queued or mid-flight stream (the losing leg of a
+        settled hedge). True when found and dropped; False when the stream
+        already went terminal (the router discards its record instead)."""
+        for i, (qrid, _req, _at) in enumerate(self._queue):
+            if qrid == rid:
+                del self._queue[i]
+                return True
+        for i, st in enumerate(self._restored):
+            if st.rid == rid:
+                del self._restored[i]
+                return True
+        if self._current is not None and self._current.rid == rid:
+            self._current = None
+            self._busy_until = None
+            return True
+        return False
+
     # -- virtual-time decode ------------------------------------------------
 
     def _chunk_of(self, st: _SimStream) -> int:
         return min(self.cfg.chunk_tokens,
                    st.req.max_new_tokens - len(st.tokens))
+
+    def _expired_record(self, st: _SimStream,
+                        now: float) -> Optional[RequestRecord]:
+        """With deadline propagation armed: refuse to schedule the next
+        phase of a stream whose (decremented) deadline has already passed —
+        a ``timed_out``/``deadline_expired`` terminal instead of tokens
+        nobody can use."""
+        if (not self.cfg.deadline_propagation
+                or st.req.deadline_s is None
+                or now - st.submitted_at < st.req.deadline_s):
+            return None
+        self._current = None
+        self._busy_until = None
+        return self._record(st.rid, st.req, TIMED_OUT,
+                            DeadlineExpired.reason, st.submitted_at,
+                            st.started_at, None, tokens_done=len(st.tokens))
 
     def drain(self, max_requests: Optional[int] = None) -> list:
         """Apply whatever is due at the current virtual instant: start a
@@ -1088,8 +1494,12 @@ class SimReplicaFront:
                 # prefill completed: index the prompt, schedule first chunk
                 st.started_at = due_at
                 self._index_prefix(st.prompt)
+                expired = self._expired_record(st, due_at)
+                if expired is not None:
+                    return [expired]
                 self._busy_until = (due_at + self.cfg.decode_s_per_token
-                                    * self._chunk_of(st))
+                                    * self._chunk_of(st)
+                                    * self._service_mult)
                 continue
             # decode chunk completed: append exactly the scheduled tokens
             k = self._chunk_of(st)
@@ -1100,8 +1510,12 @@ class SimReplicaFront:
                 chain=st.chain)
             st.tokens.extend(int(t) for t in toks)
             if len(st.tokens) < st.req.max_new_tokens:
+                expired = self._expired_record(st, due_at)
+                if expired is not None:
+                    return [expired]
                 self._busy_until = (due_at + self.cfg.decode_s_per_token
-                                    * self._chunk_of(st))
+                                    * self._chunk_of(st)
+                                    * self._service_mult)
                 continue
             self._current = None
             self._busy_until = None
@@ -1125,7 +1539,8 @@ class SimReplicaFront:
         if self._restored:
             st = self._restored.popleft()
             self._busy_until = (self.clock() + self.cfg.decode_s_per_token
-                                * self._chunk_of(st))
+                                * self._chunk_of(st)
+                                * self._service_mult)
             return st
         while self._queue:
             rid, req, sub_at = self._queue.popleft()
@@ -1135,7 +1550,8 @@ class SimReplicaFront:
                                     sub_at, None, None)
             prompt = np.asarray(req.prompt_ids, np.int32).reshape(-1)
             self._busy_until = (self.clock()
-                                + self.cfg.prefill_s_per_token * prompt.size)
+                                + self.cfg.prefill_s_per_token * prompt.size
+                                * self._service_mult)
             return _SimStream(rid=rid, req=req, prompt=prompt,
                               submitted_at=sub_at, started_at=None,
                               tokens=[], chain=None)

@@ -60,7 +60,9 @@ from ..codecs.wire_format import seal_payload, tree_nbytes, verify_payload
 from ..obs.flight import flight_dump_for
 from ..obs.metrics import get_registry
 from ..obs.tracing import span as obs_span
+from ..utils.clock import MONOTONIC, Clock
 from .batching import BatchingConfig, ContinuousBatcher
+from .overload import _linear_quantile
 
 
 # ---------------------------------------------------------------------------
@@ -86,10 +88,11 @@ class PrefillWorkerLost(DisaggError):
 #: typed degrade reasons (`DisaggServer.degrade_reason` is always one of
 #: these or None)
 DEGRADE_LINK_DEAD = "migration_link_dead"
+DEGRADE_LINK_SLOW = "migration_link_slow"
 DEGRADE_MIGRATION_FAILURES = "migration_failures"
 DEGRADE_WORKERS_LOST = "prefill_workers_lost"
-DEGRADE_REASONS = (DEGRADE_LINK_DEAD, DEGRADE_MIGRATION_FAILURES,
-                   DEGRADE_WORKERS_LOST)
+DEGRADE_REASONS = (DEGRADE_LINK_DEAD, DEGRADE_LINK_SLOW,
+                   DEGRADE_MIGRATION_FAILURES, DEGRADE_WORKERS_LOST)
 
 
 # ---------------------------------------------------------------------------
@@ -122,6 +125,16 @@ class DisaggConfig:
     hedge: Optional[HedgeConfig] = None
     faults: Optional[FaultConfig] = None
     link_seed: int = 0
+    # gray plane: a link that is merely SLOW. ``transfer_s_per_page`` models
+    # per-page wire time on the injected clock (0 keeps transfers instant);
+    # when ``slow_link_p95_multiple`` > 0 the server watches a rolling
+    # window of transfer latencies and degrades to colocated serving with
+    # the typed ``migration_link_slow`` reason once the windowed p95
+    # reaches that multiple of the frozen healthy baseline median.
+    transfer_s_per_page: float = 0.0
+    slow_link_p95_multiple: float = 0.0
+    slow_link_min_samples: int = 8
+    slow_link_window_s: float = 60.0
 
     def __post_init__(self):
         if not isinstance(self.enabled, bool):
@@ -135,6 +148,27 @@ class DisaggConfig:
             v = getattr(self, f)
             if isinstance(v, bool) or not isinstance(v, int) or v < lo:
                 raise ValueError(f"{f} must be an integer >= {lo}, got {v!r}")
+        if isinstance(self.transfer_s_per_page, bool) or not isinstance(
+                self.transfer_s_per_page, (int, float)) \
+                or self.transfer_s_per_page < 0:
+            raise ValueError(f"transfer_s_per_page must be a number >= 0, "
+                             f"got {self.transfer_s_per_page!r}")
+        if isinstance(self.slow_link_p95_multiple, bool) or not isinstance(
+                self.slow_link_p95_multiple, (int, float)) \
+                or (self.slow_link_p95_multiple != 0
+                    and self.slow_link_p95_multiple <= 1.0):
+            raise ValueError(f"slow_link_p95_multiple must be 0 (off) or "
+                             f"> 1, got {self.slow_link_p95_multiple!r}")
+        if isinstance(self.slow_link_min_samples, bool) or not isinstance(
+                self.slow_link_min_samples, int) \
+                or self.slow_link_min_samples < 2:
+            raise ValueError(f"slow_link_min_samples must be an integer "
+                             f">= 2, got {self.slow_link_min_samples!r}")
+        if isinstance(self.slow_link_window_s, bool) or not isinstance(
+                self.slow_link_window_s, (int, float)) \
+                or self.slow_link_window_s <= 0:
+            raise ValueError(f"slow_link_window_s must be a number > 0, "
+                             f"got {self.slow_link_window_s!r}")
         if isinstance(self.link_seed, bool) or not isinstance(
                 self.link_seed, int):
             raise ValueError(f"link_seed must be an integer, "
@@ -178,11 +212,18 @@ class MigrationLink:
     def __init__(self, *, fec: Optional[FECConfig] = None,
                  hedge: Optional[HedgeConfig] = None,
                  faults: Optional[FaultConfig] = None,
-                 max_retries: int = 2, seed: int = 0):
+                 max_retries: int = 2, seed: int = 0,
+                 clock: Clock = MONOTONIC, transfer_s: float = 0.0):
         self.fec = fec if (fec is not None and fec.enabled) else None
         self.hedge = hedge if (hedge is not None and hedge.enabled) else None
         self.faults = faults
         self.max_retries = int(max_retries)
+        self.clock = clock
+        #: modeled per-send wire time, burned on the virtual clock when the
+        #: injected clock supports ``advance`` (a FakeClock) — the slow-link
+        #: chaos knob inflates it via :meth:`set_transfer_multiplier`
+        self.transfer_s = float(transfer_s)
+        self._transfer_mult = 1.0
         self.alive = True
         self.counters = {"pages": 0, "transmissions": 0, "wire_bytes": 0,
                          "detected": 0, "repaired": 0, "retried": 0,
@@ -197,6 +238,21 @@ class MigrationLink:
         """Chaos switch: every later :meth:`send` raises immediately."""
         self.alive = False
 
+    def set_transfer_multiplier(self, mult: float) -> None:
+        """Gray-failure chaos switch: inflate every later send's modeled
+        wire time by this factor — the link stays up and delivers verified
+        bytes, it is merely slow."""
+        if mult <= 0:
+            raise ValueError(f"transfer multiplier must be > 0, got {mult!r}")
+        self._transfer_mult = float(mult)
+
+    def _burn_transfer_time(self) -> None:
+        if self.transfer_s <= 0.0:
+            return
+        advance = getattr(self.clock, "advance", None)
+        if advance is not None:
+            advance(self.transfer_s * self._transfer_mult)
+
     def wire_nbytes(self, payload_nbytes: int) -> int:
         return migration_wire_nbytes(payload_nbytes, self.fec)
 
@@ -207,6 +263,7 @@ class MigrationLink:
         if not self.alive:
             raise MigrationError(
                 f"migration link is down (sid={sid} page={page})")
+        self._burn_transfer_time()
         dev = jax.tree_util.tree_map(jnp.asarray, payload)
         sealed = seal_payload(dev)
         declared = migration_wire_nbytes(tree_nbytes(dev), self.fec)
@@ -376,9 +433,11 @@ class DisaggServer:
 
     def __init__(self, cfg, params, bcfg: BatchingConfig,
                  dcfg: DisaggConfig = DisaggConfig(), *,
-                 split_runtime=None, placed_params=None):
+                 split_runtime=None, placed_params=None,
+                 clock: Clock = MONOTONIC):
         self.cfg, self.params = cfg, params
         self.bcfg, self.dcfg = bcfg, dcfg
+        self.clock = clock
         self._rt_args = {"split_runtime": split_runtime,
                          "placed_params": placed_params}
         self.decode = ContinuousBatcher(cfg, params, bcfg, **self._rt_args)
@@ -393,7 +452,12 @@ class DisaggServer:
         self.link = MigrationLink(fec=dcfg.fec, hedge=dcfg.hedge,
                                   faults=dcfg.faults,
                                   max_retries=dcfg.max_retries,
-                                  seed=dcfg.link_seed)
+                                  seed=dcfg.link_seed, clock=clock,
+                                  transfer_s=dcfg.transfer_s_per_page)
+        # slow-link detection state: a rolling (t, elapsed) window plus the
+        # healthy baseline median frozen from the first min_samples sends
+        self._xfer_window: deque = deque()
+        self._xfer_baseline: Optional[float] = None
         # rows axis of every payload array: (L, n, ...) local, per-stage
         # (n_stages, sz, n, ...) split
         self._row_axis = 2 if self.decode.rt is not None else 1
@@ -508,6 +572,34 @@ class DisaggServer:
             self.stats["colocated_fallbacks"] += 1
             self._submit_colocated(sid)
 
+    def _observe_transfer(self, elapsed_s: float) -> None:
+        """Slow-link detection: freeze a healthy baseline median from the
+        first ``slow_link_min_samples`` transfers, then degrade (typed
+        ``migration_link_slow``) when the rolling window's p95 reaches
+        ``slow_link_p95_multiple`` × that baseline. Symmetric with the
+        dead-link path — the router demotes on the same ``degraded`` flag."""
+        if self.dcfg.slow_link_p95_multiple == 0 or self.degraded:
+            return
+        now = self.clock()
+        self._xfer_window.append((now, float(elapsed_s)))
+        horizon = now - self.dcfg.slow_link_window_s
+        while self._xfer_window and self._xfer_window[0][0] <= horizon:
+            self._xfer_window.popleft()
+        n = len(self._xfer_window)
+        if n < self.dcfg.slow_link_min_samples:
+            return
+        ordered = sorted(v for _, v in self._xfer_window)
+        if self._xfer_baseline is None:
+            self._xfer_baseline = _linear_quantile(ordered, 0.5)
+            return
+        if self._xfer_baseline <= 0.0:
+            return   # instant-transfer model: nothing to compare against
+        p95 = _linear_quantile(ordered, 0.95)
+        if p95 >= self.dcfg.slow_link_p95_multiple * self._xfer_baseline:
+            with obs_span("gray.demote", link="migration",
+                          p95_s=p95, baseline_s=self._xfer_baseline):
+                self._degrade(DEGRADE_LINK_SLOW)
+
     def _live_workers(self) -> list:
         return [w for w in self.workers if w.alive]
 
@@ -542,7 +634,9 @@ class DisaggServer:
             with obs_span("disagg.migrate_page", sid=sid, wid=worker.wid,
                           page=p, rows=stop - start):
                 chunk = worker.gather_page(slot, start, stop)
+                t0 = self.clock()
                 chunks.append(self.link.send(chunk, sid=sid, page=p))
+                self._observe_transfer(self.clock() - t0)
             if self.page_hook is not None:
                 self.page_hook(worker.wid, sid, p)
         return self._concat_rows(chunks, length)
@@ -559,7 +653,9 @@ class DisaggServer:
             with obs_span("disagg.migrate_page", sid=sid, wid=wid, page=p,
                           rows=stop - start, redriven=True):
                 chunk = self._slice_rows(snapshot, start, stop)
+                t0 = self.clock()
                 chunks.append(self.link.send(chunk, sid=sid, page=p))
+                self._observe_transfer(self.clock() - t0)
             pages += 1
         self.stats["redriven_pages"] += pages
         return self._concat_rows(chunks, length)
@@ -759,6 +855,13 @@ class DisaggServer:
         self.link.fail()
         self._degrade(DEGRADE_LINK_DEAD)
 
+    def slow_link(self, mult: float) -> None:
+        """Simulate the disagg link going gray: later transfers take
+        ``mult`` × the modeled wire time. The front keeps serving and
+        degrades only when the detector's windowed p95 crosses the
+        configured multiple of the healthy baseline."""
+        self.link.set_transfer_multiplier(mult)
+
     def kill_decode_worker(self) -> None:
         """Simulate the decode worker dying. Running streams re-admit via
         the existing DecodeCheckpoint path (token-identical restore) when
@@ -834,6 +937,8 @@ class DisaggServer:
             "pending": len(self.pending),
             "wire_bytes": link["wire_bytes"],
             "link": link,
+            "transfer_baseline_s": self._xfer_baseline,
+            "transfer_window": len(self._xfer_window),
             **{k: v for k, v in self.stats.items()},
         }
         return rep

@@ -378,6 +378,14 @@ class ClusterSoakConfig:
     #: ((arrival_frac, replica_id), ...) — each kills that replica just
     #: before the request at ``floor(n * frac)`` is submitted
     kills: tuple = ()
+    #: ((arrival_frac, replica_id, service_multiplier), ...) — gray
+    #: failures: just before the request at ``floor(n * frac)`` is
+    #: submitted, the replica starts serving every phase ``multiplier`` ×
+    #: slower. Re-asserted per arrival, so a respawn inherits the slowdown
+    #: — gray hardware stays gray across process restarts. Firing AFTER the
+    #: fleet has warmed up captures the nasty case: prefix affinity keeps
+    #: routing a slow replica's groups at it no matter how its queue grows.
+    slowdowns: tuple = ()
     burst_start_frac: float = 0.0
     burst_end_frac: float = 0.0
     burst_corrupt_rate: float = 0.0
@@ -412,6 +420,13 @@ class ClusterSoakConfig:
             if not 0.0 <= frac <= 1.0:
                 raise ValueError(
                     f"kill fraction must be in [0, 1], got {frac!r}")
+        for frac, _rid, mult in self.slowdowns:
+            if not 0.0 <= frac <= 1.0:
+                raise ValueError(
+                    f"slowdown fraction must be in [0, 1], got {frac!r}")
+            if mult < 1.0:
+                raise ValueError(
+                    f"slowdown multiplier must be >= 1, got {mult!r}")
         if self.goodput_bucket_s <= 0:
             raise ValueError("goodput_bucket_s must be > 0")
         if self.priority_levels < 1:
@@ -468,6 +483,9 @@ def run_cluster_soak(cluster: Any, soak: ClusterSoakConfig, *,
     n = soak.n_requests
     kill_sched = sorted((int(n * frac), int(rid))
                         for frac, rid in soak.kills)
+    slow_sched = sorted((int(n * frac), int(rid), float(mult))
+                        for frac, rid, mult in soak.slowdowns)
+    active_slowdowns: dict = {}    # replica_id -> multiplier
     burst_on_idx = (int(n * soak.burst_start_frac)
                     if soak.burst_corrupt_rate > 0
                     and soak.burst_end_frac > soak.burst_start_frac
@@ -500,6 +518,18 @@ def run_cluster_soak(cluster: Any, soak: ClusterSoakConfig, *,
             if set_rate is not None:
                 set_rate(rate)
 
+    def apply_slowdowns() -> None:
+        """(Re)assert active gray-failure service multipliers — a respawned
+        replica inherits its slowdown (the hardware is gray, not the
+        process)."""
+        for rid, mult in active_slowdowns.items():
+            r = cluster.replicas.get(rid)
+            if r is None or r.front is None:
+                continue
+            set_mult = getattr(r.front, "set_service_multiplier", None)
+            if set_mult is not None:
+                set_mult(mult)
+
     def fire_events(i: int) -> None:
         nonlocal burst_active
         while kill_sched and kill_sched[0][0] == i:
@@ -507,6 +537,9 @@ def run_cluster_soak(cluster: Any, soak: ClusterSoakConfig, *,
             cluster.kill_replica(rid, "chaos")
             kill_events.append({"replica": rid, "at_s": clock.now,
                                 "recovery_s": None})
+        while slow_sched and slow_sched[0][0] <= i:
+            _, rid, mult = slow_sched.pop(0)
+            active_slowdowns[rid] = mult
         if burst_on_idx is not None and i == burst_on_idx:
             burst_active = True
             burst_window_s.append(clock.now)
@@ -562,6 +595,7 @@ def run_cluster_soak(cluster: Any, soak: ClusterSoakConfig, *,
         while i < n and next_t <= clock.now:
             fire_events(i)
             apply_burst()
+            apply_slowdowns()
             crid = cluster.submit(_cluster_request(soak, i))
             pending_meta[crid] = i
             gap = -math.log(_u01(soak.seed, i, 0)) / soak.arrival_rate
@@ -596,6 +630,10 @@ def run_cluster_soak(cluster: Any, soak: ClusterSoakConfig, *,
         "reasons": reasons,
         "goodput_tokens_per_s": tokens_out / span_s,
         "slo_attainment": (met / with_deadline) if with_deadline else None,
+        # fleet-level SLO: deadline-met completions over ALL submitted
+        # requests, so a timed-out request counts as a miss instead of
+        # silently leaving the denominator — the gray bench gates on this
+        "slo_goodput": met / n,
         "reject_rate": outcomes.get(REJECTED, 0) / n,
         "shed_rate": outcomes.get(SHED, 0) / n,
         "timeout_rate": outcomes.get(TIMED_OUT, 0) / n,
@@ -615,6 +653,14 @@ def run_cluster_soak(cluster: Any, soak: ClusterSoakConfig, *,
         "readmitted": report["totals"]["readmitted"],
         "recompute_tokens": report["totals"]["recompute_tokens"],
         "parked_total": report["totals"]["parked_total"],
+        "hedges": report["totals"].get("hedges", 0),
+        "hedge_wins": (report["totals"].get("hedge_wins_primary", 0)
+                       + report["totals"].get("hedge_wins_hedge", 0)),
+        "hedge_discarded": report["totals"].get("hedge_discarded", 0),
+        "hedge_fraction": (report["totals"].get("hedges", 0)
+                           / max(report["totals"].get("placed", 0), 1)),
+        "deadline_expired": report["totals"].get("deadline_expired", 0),
+        "gray": report.get("gray"),
         "respawns": sum(r["respawns"]
                         for r in report["replicas"].values()),
         "flight_dumps": cluster.flight_dumps(),

@@ -1,0 +1,515 @@
+"""Gray-failure hardening (PR 20): straggler detection, request hedging,
+and deadline propagation.
+
+Correctness anchors, in order of importance:
+
+- hedging is exactly-once: every settled hedge has ONE winning record
+  (the loser is cancelled or its late record swallowed), every completed
+  request is token-identical to the fault-free reference, and nothing
+  accepted is lost;
+- the StragglerDetector's windowed quantile math is bit-compatible with
+  the numpy linear-interpolation reference, under FakeClock advance and
+  window expiry;
+- verdicts carry min-dwell hysteresis in BOTH directions and re-promotion
+  requires fresh measurements — a flagged key with an empty window stays
+  flagged;
+- deadline budgets are re-checked at every hop: a parked request whose
+  deadline lapsed before re-placement finishes as ``timed_out`` instead
+  of being served late, and a deadline-propagating replica refuses
+  expired work at prefill/decode chunk boundaries with the typed
+  ``deadline_expired`` reason;
+- parked requests re-place in ARRIVAL order (no starvation of the oldest
+  parked request when kills shuffled the park queue);
+- a sustained-slow migration link degrades the disagg front to colocated
+  with the typed ``migration_link_slow`` reason, symmetric with the
+  dead-link path;
+- the seeded gray soak is byte-deterministic: same seed + slowdown
+  schedule -> identical artifact.
+"""
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from edgellm_tpu.serve import Request
+from edgellm_tpu.serve.cluster import (ClusterConfig, ClusterConfigError,
+                                       ClusterFront, GrayConfig,
+                                       RespawnConfig, SimReplicaConfig,
+                                       SimReplicaFront, drive_cluster)
+from edgellm_tpu.serve.overload import (DeadlineExpired, StragglerConfig,
+                                        StragglerDetector, _linear_quantile)
+from edgellm_tpu.serve.soak import ClusterSoakConfig, run_cluster_soak
+from edgellm_tpu.utils.clock import FakeClock
+
+
+def _prompt(seed, n=16, vocab=50_000):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, vocab, size=n).astype(np.int32)
+
+
+def _fleet(n=2, clock=None, sim_cfg=None, **cfg_kw):
+    clock = clock if clock is not None else FakeClock()
+    scfg = sim_cfg if sim_cfg is not None else SimReplicaConfig()
+    fronts = {}
+
+    def factory(rid, gen):
+        f = SimReplicaFront(scfg, clock=clock, replica_id=rid)
+        fronts[(rid, gen)] = f
+        return f
+
+    cluster = ClusterFront(factory, ClusterConfig(num_replicas=n, **cfg_kw),
+                           clock=clock)
+    return cluster, clock, fronts
+
+
+def _drive_front(front, clock, max_steps=10_000):
+    """Drain one SimReplicaFront to quiescence on the virtual clock."""
+    recs = []
+    for _ in range(max_steps):
+        got = front.drain()
+        if got:
+            recs.extend(got)
+            continue
+        ev = front.next_event_s()
+        if ev is None:
+            return recs
+        clock.set_time(max(ev, clock.now))
+    raise AssertionError("sim front never drained")
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+def test_gray_config_validation():
+    GrayConfig()  # defaults valid (disabled)
+    with pytest.raises(ClusterConfigError):
+        GrayConfig(enabled=1)
+    with pytest.raises(ClusterConfigError):
+        GrayConfig(p95_multiple=1.0)
+    with pytest.raises(ClusterConfigError):
+        GrayConfig(hedge_delay_quantile=1.0)
+    with pytest.raises(ClusterConfigError):
+        GrayConfig(min_dwell_s=-1.0)
+    with pytest.raises(ClusterConfigError):
+        GrayConfig(max_hedge_fraction=1.5)
+    with pytest.raises(ClusterConfigError):
+        GrayConfig(min_samples=0)
+    with pytest.raises(ClusterConfigError):
+        GrayConfig(window_s=0.0)
+
+
+def test_straggler_config_validation():
+    with pytest.raises(ValueError):
+        StragglerConfig(p95_multiple=0.5)
+    with pytest.raises(ValueError):
+        StragglerConfig(quantile=1.0)
+    with pytest.raises(ValueError):
+        StragglerConfig(min_samples=9, max_samples=8)
+    with pytest.raises(ValueError):
+        StragglerConfig(min_dwell_s=-0.1)
+    with pytest.raises(ValueError):
+        SimReplicaConfig(deadline_propagation=1)
+
+
+# ---------------------------------------------------------------------------
+# detector quantile math vs the numpy reference, with window expiry
+# ---------------------------------------------------------------------------
+
+
+def test_detector_quantiles_match_numpy_under_window_expiry():
+    ck = FakeClock()
+    det = StragglerDetector(StragglerConfig(window_s=10.0, min_samples=4),
+                            clock=ck)
+    rng = np.random.default_rng(0)
+    samples = {"a": [], "b": []}
+    for _ in range(25):
+        ck.advance(0.7)   # 17.5s span: the early samples expire
+        for k, mult in (("a", 1.0), ("b", 3.0)):
+            v = float(rng.gamma(2.0, 0.05)) * mult
+            det.observe(k, v)
+            samples[k].append((ck.now, v))
+    horizon = ck.now - 10.0
+    pooled = []
+    for k in ("a", "b"):
+        vals = [v for t, v in samples[k] if t > horizon]
+        assert 0 < len(vals) < len(samples[k])   # expiry really happened
+        assert det.sample_count(k) == len(vals)
+        for q in (0.5, 0.9, 0.95, 0.99):
+            assert det.quantile(k, q) == pytest.approx(
+                float(np.quantile(vals, q)), rel=1e-12)
+        pooled.extend(vals)
+    assert det.fleet_quantile(0.5) == pytest.approx(
+        float(np.quantile(pooled, 0.5)), rel=1e-12)
+    only_a = [v for t, v in samples["a"] if t > horizon]
+    assert det.fleet_quantile(0.95, exclude={"b"}) == pytest.approx(
+        float(np.quantile(only_a, 0.95)), rel=1e-12)
+    # the whole window expires: nothing left to quantile
+    ck.advance(20.0)
+    assert det.quantile("a") is None
+    assert det.sample_count("b") == 0
+    assert det.fleet_quantile() is None
+
+
+def test_linear_quantile_matches_numpy_exactly():
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 5, 17):
+        vals = sorted(rng.standard_exponential(n).tolist())
+        for q in (0.0, 0.25, 0.5, 0.95, 1.0):
+            assert _linear_quantile(vals, q) == pytest.approx(
+                float(np.quantile(vals, q)), rel=1e-12, abs=1e-15)
+
+
+# ---------------------------------------------------------------------------
+# verdicts: flag, dwell hysteresis, re-promotion on re-measure only
+# ---------------------------------------------------------------------------
+
+
+def test_detector_flags_slow_peer_and_repromotes_on_remeasure():
+    ck = FakeClock()
+    det = StragglerDetector(
+        StragglerConfig(p95_multiple=3.0, min_samples=4, min_dwell_s=0.0,
+                        window_s=1000.0), clock=ck)
+    for _ in range(4):
+        ck.advance(0.1)
+        det.observe("a", 0.1)
+        det.observe("b", 0.1)
+    assert det.stragglers() == ()
+    for _ in range(8):
+        ck.advance(0.1)
+        det.observe("a", 0.1)
+        det.observe("b", 1.0)
+    assert det.is_straggler("b")
+    assert not det.is_straggler("a")
+    assert det.summary()["demotions"] == 1
+    # the window empties: the verdict STANDS — re-promotion requires fresh
+    # measurements, never just elapsed time
+    ck.advance(5000.0)
+    assert det.is_straggler("b")
+    # fresh fast samples (with a fleet to compare against) re-promote
+    for _ in range(4):
+        ck.advance(0.1)
+        det.observe("a", 0.1)
+        det.observe("b", 0.1)
+    assert not det.is_straggler("b")
+    assert det.summary()["promotions"] == 1
+
+
+def test_detector_min_dwell_blocks_flapping():
+    ck = FakeClock()
+    det = StragglerDetector(
+        StragglerConfig(p95_multiple=3.0, min_samples=4, min_dwell_s=5.0,
+                        window_s=2.0), clock=ck)
+    for i in range(12):
+        ck.advance(0.1)
+        det.observe("a", 0.1)
+        det.observe("b", 1.0 if i >= 8 else 0.1)
+    assert det.is_straggler("b")
+    flagged_at = ck.now
+    # b turns healthy immediately, but the verdict may not flip back
+    # inside the dwell window even with fresh fast samples
+    while ck.now - flagged_at < 3.0:
+        ck.advance(0.1)
+        det.observe("a", 0.1)
+        det.observe("b", 0.1)
+    assert det.is_straggler("b")   # dwell still holds it down
+    while ck.now - flagged_at < 6.0:
+        ck.advance(0.1)
+        det.observe("a", 0.1)
+        det.observe("b", 0.1)
+    assert not det.is_straggler("b")
+    assert det.summary() == {"keys": 2, "flagged": [], "observed":
+                             det.summary()["observed"], "demotions": 1,
+                             "promotions": 1}
+
+
+def test_detector_needs_a_fleet_and_min_samples():
+    ck = FakeClock()
+    det = StragglerDetector(StragglerConfig(min_samples=4, min_dwell_s=0.0),
+                            clock=ck)
+    # one slow key alone: no fleet to be slower than
+    for _ in range(8):
+        ck.advance(0.1)
+        det.observe("b", 5.0)
+    assert not det.is_straggler("b")
+    # a peer appears but b is below min fresh samples after expiry: the
+    # verdict cannot form from thin evidence
+    det2 = StragglerDetector(StragglerConfig(min_samples=4, min_dwell_s=0.0),
+                             clock=ck)
+    for _ in range(3):
+        ck.advance(0.1)
+        det2.observe("a", 0.1)
+        det2.observe("b", 5.0)
+    assert not det2.is_straggler("b")
+    with pytest.raises(ValueError):
+        det.observe("a", -1.0)
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation inside a replica (typed refusal of expired work)
+# ---------------------------------------------------------------------------
+
+
+def test_sim_replica_refuses_expired_work_with_typed_reason():
+    ck = FakeClock()
+    front = SimReplicaFront(SimReplicaConfig(deadline_propagation=True),
+                            clock=ck, replica_id=0)
+    front.submit(Request(prompt_ids=_prompt(1), max_new_tokens=64,
+                         deadline_s=0.05))
+    recs = _drive_front(front, ck)
+    assert [r.outcome for r in recs] == ["timed_out"]
+    assert recs[0].reason == DeadlineExpired.reason == "deadline_expired"
+    assert recs[0].deadline_met is not True
+    # the budget died mid-decode: some tokens were produced, not all
+    assert 0 < recs[0].recovery["tokens_done"] < 64
+
+
+def test_deadline_propagation_off_by_default_serves_late():
+    ck = FakeClock()
+    front = SimReplicaFront(SimReplicaConfig(), clock=ck, replica_id=0)
+    front.submit(Request(prompt_ids=_prompt(1), max_new_tokens=64,
+                         deadline_s=0.05))
+    recs = _drive_front(front, ck)
+    # the PR-19 replica serves to completion (the deadline is only audited
+    # at the cluster edge): bit-identical legacy behavior
+    assert [r.outcome for r in recs] == ["completed"]
+    assert recs[0].deadline_met is False
+
+
+def test_sim_replica_cancel_exactly_once():
+    ck = FakeClock()
+    front = SimReplicaFront(SimReplicaConfig(), clock=ck, replica_id=0)
+    keep = front.submit(Request(prompt_ids=_prompt(1), max_new_tokens=4))
+    drop = front.submit(Request(prompt_ids=_prompt(2), max_new_tokens=4))
+    assert front.cancel(drop) is True
+    assert front.cancel(drop) is False      # already gone
+    assert front.cancel(999_999) is False   # unknown rid
+    recs = _drive_front(front, ck)
+    assert [r.request_id for r in recs] == [keep]
+    # cancelling the in-flight stream clears it too
+    running = front.submit(Request(prompt_ids=_prompt(3), max_new_tokens=8))
+    front.drain()   # pops the queue: the stream is now _current
+    assert front.cancel(running) is True
+    assert _drive_front(front, ck) == []
+
+
+# ---------------------------------------------------------------------------
+# deadline re-check at (re-)placement: a parked request cannot be served
+# after its budget lapsed (the audit fix)
+# ---------------------------------------------------------------------------
+
+
+def test_parked_request_expires_at_replacement_not_served_late():
+    rs = RespawnConfig(backoff_base_s=100.0, jitter_frac=0.0)
+    cluster, clock, _ = _fleet(2, respawn=rs)
+    crid = cluster.submit(Request(prompt_ids=_prompt(1), max_new_tokens=8,
+                                  deadline_s=5.0))
+    cluster.kill_replica(0, "chaos")
+    cluster.kill_replica(1, "chaos")
+    assert cluster.pending == 1   # parked, not lost
+    # the respawn lands long after the deadline: re-placement must refuse
+    # the expired work instead of serving it late
+    clock.advance(200.0)
+    recs = drive_cluster(cluster, clock)
+    assert [r.request_id for r in recs] == [crid]
+    assert recs[0].outcome == "timed_out"
+    assert recs[0].reason == "deadline_expired"
+    assert recs[0].deadline_met is False
+    assert cluster.totals["deadline_expired"] == 1
+    assert cluster.pending == 0
+
+
+# ---------------------------------------------------------------------------
+# parked starvation guard: re-placement in ARRIVAL order
+# ---------------------------------------------------------------------------
+
+
+def test_parked_requests_replace_in_arrival_order():
+    rs = RespawnConfig(backoff_base_s=100.0, jitter_frac=0.0)
+    cluster, clock, _ = _fleet(2, respawn=rs)
+    first = cluster.submit(Request(prompt_ids=_prompt(1), max_new_tokens=4))
+    second = cluster.submit(Request(prompt_ids=_prompt(2), max_new_tokens=4))
+    # killing replica 0 re-admits `first` to the TAIL of replica 1's
+    # queue; killing replica 1 then parks in queue order [second, first] —
+    # the park list is now out of arrival order
+    cluster.kill_replica(0, "chaos")
+    cluster.kill_replica(1, "chaos")
+    assert cluster.pending == 2
+    clock.advance(200.0)
+    recs = drive_cluster(cluster, clock)
+    # the starvation guard re-places oldest-first: `first` lands on the
+    # first replica slot and finishes ahead of `second`
+    assert [r.request_id for r in recs] == [first, second]
+    assert all(r.outcome == "completed" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# hedging: exactly-once settlement, token identity, bounded overhead
+# ---------------------------------------------------------------------------
+
+GRAY = GrayConfig(enabled=True, min_dwell_s=0.5, min_samples=8,
+                  window_s=30.0, max_hedge_fraction=0.4)
+SLOWDOWNS = ((0.3, 0, 20.0),)
+SOAK_KW = dict(n_requests=300, arrival_rate=30.0, deadline_s=0.5, seed=7)
+
+
+def _gray_soak(gray, slowdowns, **kw):
+    clock = FakeClock()
+    scfg = SimReplicaConfig(deadline_propagation=gray.enabled)
+    cluster = ClusterFront(
+        lambda rid, gen: SimReplicaFront(scfg, clock=clock, replica_id=rid),
+        ClusterConfig(num_replicas=3, gray=gray), clock=clock)
+    art = run_cluster_soak(cluster, ClusterSoakConfig(
+        slowdowns=slowdowns, **kw), clock=clock)
+    return art, cluster
+
+
+def test_hedged_soak_exactly_once_and_token_identity():
+    art, cluster = _gray_soak(GRAY, SLOWDOWNS, **SOAK_KW)
+    n = SOAK_KW["n_requests"]
+    assert sum(art["outcomes"].values()) == n   # zero accepted loss
+    assert art["outcomes"].get("failed", 0) == 0
+    assert cluster.pending == 0
+    assert art["hedges"] > 0
+    t = cluster.totals
+    # every hedge settled exactly once: one winning leg, one loser that
+    # was cancelled or had its late record swallowed
+    assert t["hedge_wins_primary"] + t["hedge_wins_hedge"] == t["hedges"]
+    assert t["hedge_cancelled"] + t["hedge_discarded"] == t["hedges"]
+    assert art["hedge_fraction"] <= GRAY.max_hedge_fraction + 1e-9
+    # first-finisher-wins never surfaces a duplicate or divergent stream
+    ident = art["token_identity"]
+    assert ident["ok"] and ident["checked"] > 0
+    assert ident["mismatched_ids"] == []
+    # the gray plane beats the unhedged fleet on the same slowdown (the
+    # full 1.5x gate runs at bench scale, BENCH_GRAY=1)
+    base, _ = _gray_soak(GrayConfig(), SLOWDOWNS, **SOAK_KW)
+    assert base["hedges"] == 0
+    assert base["slo_goodput"] < 0.9 < art["slo_goodput"]
+    assert art["slo_goodput"] > base["slo_goodput"]
+
+
+def test_hedge_disabled_fleet_runs_no_gray_machinery():
+    art, cluster = _gray_soak(GrayConfig(), (), **SOAK_KW)
+    assert art["gray"] is None
+    assert art["hedges"] == 0 and art["deadline_expired"] == 0
+    assert cluster.report()["gray"] is None
+    assert sum(art["outcomes"].values()) == SOAK_KW["n_requests"]
+
+
+def test_gray_soak_is_byte_deterministic():
+    a1, _ = _gray_soak(GRAY, SLOWDOWNS, **SOAK_KW)
+    a2, _ = _gray_soak(GRAY, SLOWDOWNS, **SOAK_KW)
+    assert (json.dumps(a1, sort_keys=True, default=float)
+            == json.dumps(a2, sort_keys=True, default=float))
+
+
+def test_soak_slowdown_schedule_validation():
+    with pytest.raises(ValueError):
+        ClusterSoakConfig(slowdowns=((1.5, 0, 2.0),))
+    with pytest.raises(ValueError):
+        ClusterSoakConfig(slowdowns=((0.5, 0, 0.5),))
+    with pytest.raises(ValueError):
+        SimReplicaFront(SimReplicaConfig(),
+                        clock=FakeClock()).set_service_multiplier(0.0)
+
+
+# ---------------------------------------------------------------------------
+# slow migration link: degrade-to-colocated with the typed reason
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_slow_migration_link_degrades_with_typed_reason():
+    import jax
+
+    from edgellm_tpu.models import init_params, tiny_config
+    from edgellm_tpu.serve.batching import BatchingConfig, ContinuousBatcher
+    from edgellm_tpu.serve.disagg import (DEGRADE_LINK_SLOW, DisaggConfig,
+                                          DisaggServer)
+
+    cfg = tiny_config("qwen2", num_layers=2, hidden_size=32, num_heads=4,
+                      vocab_size=128)
+    params = init_params(cfg, jax.random.key(1))
+    bcfg = BatchingConfig(page_size=8, num_pages=17, max_slots=4,
+                          pages_per_slot=4)
+    rng = np.random.default_rng(5)
+
+    def reqs(seed0, k=4):
+        return [(rng.integers(1, cfg.vocab_size, size=9).astype(np.int32),
+                 4, 0.0, seed0 + i) for i in range(k)]
+
+    ck = FakeClock()
+    srv = DisaggServer(cfg, params, bcfg, DisaggConfig(
+        num_prefill_workers=1, transfer_s_per_page=0.01,
+        slow_link_p95_multiple=3.0, slow_link_min_samples=4,
+        slow_link_window_s=1e9), clock=ck)
+    ref = ContinuousBatcher(cfg, params, bcfg)
+
+    def serve_and_check(batch):
+        ref_sids = [ref.submit(p, m, temperature=t, rng_seed=s)
+                    for p, m, t, s in batch]
+        want = ref.run()
+        sids = [srv.submit(p, m, temperature=t, rng_seed=s)
+                for p, m, t, s in batch]
+        got = srv.run()
+        for rs, ss in zip(ref_sids, sids):
+            assert np.array_equal(want[rs], got[ss])
+
+    # healthy phase: enough transfers to freeze the baseline median
+    serve_and_check(reqs(0, k=6))
+    assert not srv.degraded
+    rep = srv.report()["disagg"]
+    assert rep["transfer_baseline_s"] is not None
+    # the link goes gray: transfers now take 10x the modeled wire time;
+    # the windowed p95 crosses 3x baseline and the front demotes itself
+    srv.slow_link(10.0)
+    serve_and_check(reqs(100, k=6))
+    assert srv.degraded
+    assert srv.degrade_reason == DEGRADE_LINK_SLOW
+    # degraded serving still completes token-identically (colocated path)
+    serve_and_check(reqs(200, k=2))
+
+
+def test_disagg_slow_link_config_validation():
+    from edgellm_tpu.serve.disagg import DisaggConfig
+
+    with pytest.raises(ValueError):
+        DisaggConfig(slow_link_p95_multiple=0.5)
+    with pytest.raises(ValueError):
+        DisaggConfig(slow_link_min_samples=1)
+    with pytest.raises(ValueError):
+        DisaggConfig(slow_link_window_s=0.0)
+    with pytest.raises(ValueError):
+        DisaggConfig(transfer_s_per_page=-0.1)
+    DisaggConfig(slow_link_p95_multiple=0.0)   # 0 disables the detector
+
+
+# ---------------------------------------------------------------------------
+# cluster report/artifact surface
+# ---------------------------------------------------------------------------
+
+
+def test_gray_report_surface():
+    art, cluster = _gray_soak(GRAY, SLOWDOWNS, **SOAK_KW)
+    rep = cluster.report()
+    assert sorted(rep["gray"]) == ["detector", "flagged", "hedge_delay_s"]
+    assert rep["gray"]["detector"]["observed"] > 0
+    assert art["gray"] == rep["gray"]
+    for key in ("hedges", "hedge_wins", "hedge_discarded", "hedge_fraction",
+                "deadline_expired", "slo_goodput"):
+        assert key in art
+    # slo_goodput counts timeouts as misses: met / ALL requests
+    met = round(art["slo_goodput"] * SOAK_KW["n_requests"])
+    assert met <= art["outcomes"].get("completed", 0)
+
+
+def test_gray_config_threads_through_cluster_config():
+    cc = ClusterConfig(num_replicas=2, gray=GRAY)
+    assert cc.gray.enabled
+    with pytest.raises(ClusterConfigError):
+        ClusterConfig(num_replicas=2, gray={"enabled": True})
+    assert dataclasses.asdict(ClusterConfig(num_replicas=2))["gray"][
+        "enabled"] is False
